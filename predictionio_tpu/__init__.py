@@ -1,0 +1,30 @@
+"""predictionio_tpu — a TPU-native machine learning server framework.
+
+A from-scratch rebuild of the capabilities of Apache PredictionIO
+(incubating): a REST event server over a pluggable store, a DASE engine
+abstraction (DataSource -> Preparator -> Algorithm(s) -> Serving) with typed
+JSON parameters, a CLI (train / deploy / eval / batchpredict / app and
+access-key management), model persistence with an engine-instance registry,
+a deployable REST prediction server, and a metric-driven evaluation
+workflow — with all numerical compute expressed as JAX/XLA programs sharded
+over TPU meshes instead of Spark/MLlib jobs.
+
+Layer map (mirrors reference layers, see SURVEY.md §1):
+  data/      event model, storage SPI + drivers, event REST server
+  ingest/    events -> dense sharded jax.Array columns (the RDD replacement)
+  core/      DASE abstractions, Engine, workflow, evaluation, persistence
+  ops/       XLA/Pallas numerical kernels (ALS, NB, logreg, cooccurrence...)
+  parallel/  mesh construction, named shardings, collectives
+  models/    official engine templates (recommendation, similarproduct, ...)
+  serving/   prediction REST server
+  cli/       the `pio`-equivalent command line tool
+  e2/        reusable engine/evaluation helpers
+"""
+
+__version__ = "0.1.0"
+
+BUILD_COORDINATES = {
+    "name": "predictionio_tpu",
+    "version": __version__,
+    "reference": "apache/incubator-predictionio 0.11.1-SNAPSHOT",
+}

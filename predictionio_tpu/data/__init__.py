@@ -1,0 +1,19 @@
+"""Data layer: event model, property aggregation, storage SPI, event server.
+
+Mirrors the reference's `data/` module (see SURVEY.md §2.2): the canonical
+Event record and validation rules, the DataMap property bag, the
+$set/$unset/$delete aggregation monoid, the storage registry with pluggable
+drivers, and the REST Event Server.
+"""
+
+from predictionio_tpu.data.event import Event, DataMap, EventValidation, PropertyMap
+from predictionio_tpu.data.aggregate import EventOp, aggregate_properties
+
+__all__ = [
+    "Event",
+    "DataMap",
+    "EventValidation",
+    "PropertyMap",
+    "EventOp",
+    "aggregate_properties",
+]

@@ -1,0 +1,23 @@
+"""Storage SPI + drivers (reference: `data/.../storage/`).
+
+`registry.storage()` is the process-wide entry point, the analog of the
+reference's `Storage` object.
+"""
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
+    EngineInstanceStatus, EngineInstances, EvaluationInstance,
+    EvaluationInstanceStatus, EvaluationInstances, EventStore, Model, Models,
+    StorageError, StorageWriteError,
+)
+from predictionio_tpu.data.storage.registry import (
+    StorageRegistry, register_driver, set_default, storage,
+)
+
+__all__ = [
+    "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
+    "EngineInstance", "EngineInstanceStatus", "EngineInstances",
+    "EvaluationInstance", "EvaluationInstanceStatus", "EvaluationInstances",
+    "EventStore", "Model", "Models", "StorageError", "StorageWriteError",
+    "StorageRegistry", "register_driver", "set_default", "storage",
+]

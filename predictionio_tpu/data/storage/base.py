@@ -1,0 +1,431 @@
+"""Storage SPI: DAO interfaces and metadata records.
+
+Parity targets in the reference:
+  - meta records/DAOs: `data/.../storage/{Apps,AccessKeys,Channels,
+    EngineInstances,EvaluationInstances,Models}.scala`
+  - event DAO: `data/.../storage/LEvents.scala:40-520` (the non-Spark event
+    access used by servers and the CLI). The reference's separate `PEvents`
+    (Spark RDD access) has no direct analog here: its role — bulk reads for
+    training — is played by `predictionio_tpu.ingest`, which streams
+    `EventStore.find` results into dense sharded jax.Arrays.
+
+Drivers implement these ABCs and are discovered by the registry in
+`predictionio_tpu.data.storage` (see `registry.py`) from layered config, the
+analog of `Storage.scala:159-357`'s env-driven reflection.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import secrets
+import re
+from dataclasses import dataclass, field, replace
+from datetime import datetime
+from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.aggregate import aggregate_properties
+from predictionio_tpu.data.event import Event, PropertyMap, utcnow
+
+
+class StorageError(Exception):
+    """Parity: StorageException (Storage.scala:88)."""
+
+
+class StorageWriteError(StorageError):
+    """A write rejected by the backend (duplicate key, constraint violation)."""
+
+
+# ---------------------------------------------------------------------------
+# Meta data records
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class App:
+    """An application namespace for events (Apps.scala:25-35)."""
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    """An API access key; empty `events` list = all events allowed
+    (AccessKeys.scala:25-38)."""
+    key: str
+    appid: int
+    events: Sequence[str] = ()
+
+
+CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+CHANNEL_NAME_CONSTRAINT = (
+    "Only alphanumeric and - characters are allowed and max length is 16.")
+
+
+@dataclass(frozen=True)
+class Channel:
+    """A named event channel within an app (Channels.scala:25-62)."""
+    id: int
+    name: str
+    appid: int
+
+    def __post_init__(self):
+        if not self.is_valid_name(self.name):
+            raise ValueError(
+                f"Invalid channel name: {self.name}. {CHANNEL_NAME_CONSTRAINT}")
+
+    @staticmethod
+    def is_valid_name(s: str) -> bool:
+        return bool(CHANNEL_NAME_RE.match(s))
+
+
+class EngineInstanceStatus:
+    INIT = "INIT"
+    TRAINING = "TRAINING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+
+
+class EvaluationInstanceStatus:
+    INIT = "EVALINIT"
+    RUNNING = "EVALRUNNING"
+    COMPLETED = "EVALCOMPLETED"
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """Metadata row for one train run (EngineInstances.scala:25-60).
+
+    `runtime_conf` replaces the reference's `sparkConf`: it carries the
+    JAX runtime configuration (mesh shape, platform, precision flags).
+    """
+    id: str = ""
+    status: str = ""
+    start_time: datetime = field(default_factory=utcnow)
+    end_time: datetime = field(default_factory=utcnow)
+    engine_id: str = ""
+    engine_version: str = ""
+    engine_variant: str = ""
+    engine_factory: str = ""
+    batch: str = ""
+    env: Mapping[str, str] = field(default_factory=dict)
+    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+    def with_(self, **kw) -> "EngineInstance":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """Metadata row for one eval run (EvaluationInstances.scala:25-56)."""
+    id: str = ""
+    status: str = ""
+    start_time: datetime = field(default_factory=utcnow)
+    end_time: datetime = field(default_factory=utcnow)
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Mapping[str, str] = field(default_factory=dict)
+    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+    def with_(self, **kw) -> "EvaluationInstance":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class Model:
+    """Serialized model blob keyed by engine instance ID (Models.scala:25-33)."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# DAO interfaces
+# ---------------------------------------------------------------------------
+
+class Apps(abc.ABC):
+    """App CRUD (Apps.scala:43-61)."""
+
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; a 0 id means 'generate one'. Returns the effective id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> None: ...
+
+
+class AccessKeys(abc.ABC):
+    """Access key CRUD + generation (AccessKeys.scala:46-77)."""
+
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> Optional[str]:
+        """Insert; empty key means 'generate one'. Returns the effective key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    def generate_key(self) -> str:
+        """URL-safe 48-byte random key, never starting with '-'
+        (AccessKeys.scala:68-77)."""
+        while True:
+            key = base64.urlsafe_b64encode(secrets.token_bytes(48)).decode().rstrip("=")
+            if not key.startswith("-"):
+                return key
+
+
+class Channels(abc.ABC):
+    """Channel CRUD (Channels.scala:64-81)."""
+
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]:
+        """Insert; a 0 id means 'generate one'. Returns the effective id."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> None: ...
+
+
+class EngineInstances(abc.ABC):
+    """Engine instance registry (EngineInstances.scala:62-100)."""
+
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, iid: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(self, engine_id: str, engine_version: str,
+                             engine_variant: str) -> Optional[EngineInstance]:
+        """Most recent COMPLETED instance for (id, version, variant) — the
+        row `deploy` resolves (EngineInstances.scala getLatestCompleted)."""
+
+    @abc.abstractmethod
+    def get_completed(self, engine_id: str, engine_version: str,
+                      engine_variant: str) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, iid: str) -> None: ...
+
+
+class EvaluationInstances(abc.ABC):
+    """Evaluation instance registry (EvaluationInstances.scala:58-84)."""
+
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, iid: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]:
+        """COMPLETED instances, reverse-sorted by start time."""
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, iid: str) -> None: ...
+
+
+class Models(abc.ABC):
+    """Model blob store (Models.scala:36-45)."""
+
+    @abc.abstractmethod
+    def insert(self, m: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, mid: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, mid: str) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Event store
+# ---------------------------------------------------------------------------
+
+_UNSET = object()  # sentinel distinguishing "no filter" from "filter == None"
+
+
+class EventStore(abc.ABC):
+    """Event DAO, the analog of the reference's `LEvents` trait
+    (LEvents.scala:40-520). All operations are scoped to an (app, channel);
+    channel_id None = the app's default channel.
+
+    Filter semantics of `find` match `LEvents.futureFind`:
+      - start_time inclusive, until_time exclusive
+      - event_names: any-of filter
+      - target_entity_type/id use a three-state convention: leave the kwarg
+        at its default for "no filter"; pass None to match events WITHOUT a
+        target entity; pass a string to match it exactly (the reference's
+        Option[Option[String]]).
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize storage for an (app, channel); idempotent."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Drop all events of an (app, channel)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        """Insert one event (validated first); returns its id."""
+        from predictionio_tpu.data.event import EventValidation
+        EventValidation.validate(event)
+        return self._insert(event, app_id, channel_id)
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        from predictionio_tpu.data.event import EventValidation
+        for e in events:
+            EventValidation.validate(e)
+        return self._insert_batch(events, app_id, channel_id)
+
+    @abc.abstractmethod
+    def _insert(self, event: Event, app_id: int,
+                channel_id: Optional[int] = None) -> str: ...
+
+    def _insert_batch(self, events: Sequence[Event], app_id: int,
+                      channel_id: Optional[int] = None) -> List[str]:
+        return [self._insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def find(self, app_id: int, channel_id: Optional[int] = None, *,
+             start_time: Optional[datetime] = None,
+             until_time: Optional[datetime] = None,
+             entity_type: Optional[str] = None,
+             entity_id: Optional[str] = None,
+             event_names: Optional[Sequence[str]] = None,
+             target_entity_type: object = _UNSET,
+             target_entity_id: object = _UNSET,
+             limit: Optional[int] = None,
+             reversed: bool = False) -> Iterator[Event]:
+        """Find events; limit None = unlimited, limit <= 0 = unlimited
+        (LEvents futureFind; the API layer applies its own default of 20).
+        reversed=True requires entity_type+entity_id in the API layer; the
+        store just sorts descending by event time."""
+
+    # -- derived operations --------------------------------------------------
+    def aggregate_properties(self, app_id: int,
+                             channel_id: Optional[int] = None, *,
+                             entity_type: str,
+                             start_time: Optional[datetime] = None,
+                             until_time: Optional[datetime] = None,
+                             required: Optional[Sequence[str]] = None,
+                             ) -> Dict[str, PropertyMap]:
+        """Replay $set/$unset/$delete into final per-entity properties
+        (LEvents.futureAggregateProperties, LEvents.scala:393-440)."""
+        events = self.find(
+            app_id, channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type,
+            event_names=["$set", "$unset", "$delete"])
+        result = aggregate_properties(events)
+        if required:
+            req = list(required)
+            result = {k: v for k, v in result.items()
+                      if all(r in v.fields for r in req)}
+        return result
+
+    def aggregate_properties_of_entity(
+            self, app_id: int, channel_id: Optional[int] = None, *,
+            entity_type: str, entity_id: str,
+            start_time: Optional[datetime] = None,
+            until_time: Optional[datetime] = None) -> Optional[PropertyMap]:
+        from predictionio_tpu.data.aggregate import aggregate_properties_single
+        events = self.find(
+            app_id, channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=["$set", "$unset", "$delete"])
+        return aggregate_properties_single(events)
+
+
+def match_event(e: Event, *,
+                start_time: Optional[datetime] = None,
+                until_time: Optional[datetime] = None,
+                entity_type: Optional[str] = None,
+                entity_id: Optional[str] = None,
+                event_names: Optional[Sequence[str]] = None,
+                target_entity_type: object = _UNSET,
+                target_entity_id: object = _UNSET) -> bool:
+    """Shared in-memory filter predicate implementing `find` semantics."""
+    if start_time is not None and e.event_time < _aware(start_time):
+        return False
+    if until_time is not None and e.event_time >= _aware(until_time):
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in set(event_names):
+        return False
+    if target_entity_type is not _UNSET and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not _UNSET and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+def _aware(t: datetime) -> datetime:
+    from datetime import timezone
+    return t if t.tzinfo else t.replace(tzinfo=timezone.utc)

@@ -1,0 +1,45 @@
+"""Local-filesystem model store ("LOCALFS" type).
+
+Parity: reference `storage/localfs/.../LocalFSModels.scala:62` — model blobs
+as files `pio_model_<id>` under a configured directory.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+class LocalFSStorageClient:
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        path = self.config.get("PATH", self.config.get("path", "~/.pio_store/models"))
+        self.path = Path(os.path.expanduser(path))
+        self.path.mkdir(parents=True, exist_ok=True)
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, client: LocalFSStorageClient):
+        self.c = client
+
+    def _file(self, mid: str) -> Path:
+        safe = "".join(ch if ch.isalnum() or ch in "-_" else "_" for ch in mid)
+        return self.c.path / f"pio_model_{safe}"
+
+    def insert(self, m: Model) -> None:
+        self._file(m.id).write_bytes(m.models)
+
+    def get(self, mid: str) -> Optional[Model]:
+        f = self._file(mid)
+        if not f.exists():
+            return None
+        return Model(mid, f.read_bytes())
+
+    def delete(self, mid: str) -> None:
+        f = self._file(mid)
+        if f.exists():
+            f.unlink()

@@ -1,0 +1,237 @@
+"""Storage registry: config-driven backend discovery and DAO construction.
+
+Parity: reference `data/.../storage/Storage.scala:147-452` — sources are
+declared via `PIO_STORAGE_SOURCES_<NAME>_TYPE` (+ driver-specific keys like
+`_PATH`), repositories bind the three data roles to sources via
+`PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}`.
+Configuration layers (highest wins): explicit dict > process env >
+`pio-env` file (simple KEY=VALUE lines) named by `$PIO_ENV_FILE` or found
+at `./pio-env` / `~/.pio_store/pio-env`.
+
+Unlike the reference's classpath reflection, drivers register here in an
+explicit table (`DRIVERS`), extensible via `register_driver`. When no
+configuration is present at all, a zero-config default of a single SQLITE
+source at `./.pio_store/pio.db` is used so quickstarts need no setup.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from pathlib import Path
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import StorageError
+
+
+# type name -> (client factory, {dao role -> DAO class name on module})
+DRIVERS: Dict[str, Dict[str, object]] = {}
+
+
+def register_driver(type_name: str, client_factory: Callable,
+                    daos: Mapping[str, Callable]) -> None:
+    DRIVERS[type_name.upper()] = {"client": client_factory, "daos": dict(daos)}
+
+
+def _register_builtin_drivers() -> None:
+    from predictionio_tpu.data.storage import localfs, memory, sqlite
+
+    register_driver("MEM", memory.MemStorageClient, {
+        "Apps": memory.MemApps,
+        "AccessKeys": memory.MemAccessKeys,
+        "Channels": memory.MemChannels,
+        "EngineInstances": memory.MemEngineInstances,
+        "EvaluationInstances": memory.MemEvaluationInstances,
+        "Models": memory.MemModels,
+        "Events": memory.MemEvents,
+    })
+    register_driver("SQLITE", sqlite.SQLiteStorageClient, {
+        "Apps": sqlite.SQLiteApps,
+        "AccessKeys": sqlite.SQLiteAccessKeys,
+        "Channels": sqlite.SQLiteChannels,
+        "EngineInstances": sqlite.SQLiteEngineInstances,
+        "EvaluationInstances": sqlite.SQLiteEvaluationInstances,
+        "Models": sqlite.SQLiteModels,
+        "Events": sqlite.SQLiteEvents,
+    })
+    register_driver("LOCALFS", localfs.LocalFSStorageClient, {
+        "Models": localfs.LocalFSModels,
+    })
+
+
+_register_builtin_drivers()
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+
+def load_env_file(path: Optional[str] = None) -> Dict[str, str]:
+    """Load KEY=VALUE lines from a pio-env file (bin/load-pio-env.sh analog)."""
+    candidates = [path] if path else [
+        os.environ.get("PIO_ENV_FILE"),
+        "./pio-env", os.path.expanduser("~/.pio_store/pio-env")]
+    out: Dict[str, str] = {}
+    for cand in candidates:
+        if cand and Path(cand).is_file():
+            for line in Path(cand).read_text().splitlines():
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                out[k.strip()] = v.strip().strip('"').strip("'")
+            break
+    return out
+
+
+def effective_config(overrides: Optional[Mapping[str, str]] = None
+                     ) -> Dict[str, str]:
+    """Layered config: env file < process env < explicit overrides."""
+    cfg = load_env_file()
+    cfg.update({k: v for k, v in os.environ.items() if k.startswith("PIO_")})
+    if overrides:
+        cfg.update(overrides)
+    return cfg
+
+
+class StorageRegistry:
+    """Holds sources (driver clients) and repository bindings; hands out DAOs.
+
+    The accessor surface mirrors `Storage.scala:399-452`.
+    """
+
+    def __init__(self, config: Optional[Mapping[str, str]] = None):
+        self.config = effective_config(config)
+        self._lock = threading.RLock()
+        self._clients: Dict[str, object] = {}
+        self._daos: Dict[Tuple[str, str], object] = {}
+        self.sources, self.repositories = self._parse(self.config)
+
+    @staticmethod
+    def _parse(cfg: Mapping[str, str]):
+        sources: Dict[str, Dict[str, str]] = {}
+        for k, v in cfg.items():
+            m = _SOURCE_RE.match(k)
+            if m:
+                sources.setdefault(m.group(1), {})[m.group(2)] = v
+        repos: Dict[str, Dict[str, str]] = {}
+        for k, v in cfg.items():
+            m = _REPO_RE.match(k)
+            if m:
+                repos.setdefault(m.group(1), {})[m.group(2)] = v
+        if not sources:
+            # zero-config default: one sqlite file source for everything
+            sources = {"PIO": {"TYPE": "SQLITE",
+                               "PATH": "./.pio_store/pio.db"}}
+        for repo in REPOSITORIES:
+            repos.setdefault(repo, {})
+            repos[repo].setdefault("SOURCE", next(iter(sources)))
+            repos[repo].setdefault("NAME", "pio_" + repo.lower())
+        for name, scfg in sources.items():
+            if "TYPE" not in scfg:
+                raise StorageError(
+                    f"Storage source {name} has no TYPE configured "
+                    f"(PIO_STORAGE_SOURCES_{name}_TYPE)")
+            if scfg["TYPE"].upper() not in DRIVERS:
+                raise StorageError(
+                    f"Storage source {name} has unknown TYPE "
+                    f"{scfg['TYPE']!r}; known: {sorted(DRIVERS)}")
+        return sources, repos
+
+    # -- plumbing -----------------------------------------------------------
+    def _client(self, source_name: str):
+        with self._lock:
+            if source_name not in self._clients:
+                if source_name not in self.sources:
+                    raise StorageError(f"Undefined storage source: {source_name}")
+                scfg = self.sources[source_name]
+                driver = DRIVERS[scfg["TYPE"].upper()]
+                if scfg["TYPE"].upper() == "SQLITE" and "PATH" in scfg:
+                    Path(scfg["PATH"]).expanduser().parent.mkdir(
+                        parents=True, exist_ok=True)
+                self._clients[source_name] = driver["client"](scfg)
+            return self._clients[source_name]
+
+    def get_data_object(self, source_name: str, dao: str):
+        """Parity: Storage.getDataObject (Storage.scala:308-357)."""
+        with self._lock:
+            key = (source_name, dao)
+            if key not in self._daos:
+                scfg = self.sources[source_name]
+                driver = DRIVERS[scfg["TYPE"].upper()]
+                if dao not in driver["daos"]:
+                    raise StorageError(
+                        f"Storage type {scfg['TYPE']} does not support "
+                        f"data object {dao}")
+                self._daos[key] = driver["daos"][dao](self._client(source_name))
+            return self._daos[key]
+
+    def _repo_dao(self, repo: str, dao: str):
+        return self.get_data_object(self.repositories[repo]["SOURCE"], dao)
+
+    # -- public accessors (Storage.scala:399-452) ---------------------------
+    def get_meta_data_apps(self) -> base.Apps:
+        return self._repo_dao("METADATA", "Apps")
+
+    def get_meta_data_access_keys(self) -> base.AccessKeys:
+        return self._repo_dao("METADATA", "AccessKeys")
+
+    def get_meta_data_channels(self) -> base.Channels:
+        return self._repo_dao("METADATA", "Channels")
+
+    def get_meta_data_engine_instances(self) -> base.EngineInstances:
+        return self._repo_dao("METADATA", "EngineInstances")
+
+    def get_meta_data_evaluation_instances(self) -> base.EvaluationInstances:
+        return self._repo_dao("METADATA", "EvaluationInstances")
+
+    def get_model_data_models(self) -> base.Models:
+        return self._repo_dao("MODELDATA", "Models")
+
+    def get_events(self) -> base.EventStore:
+        """The LEvents/PEvents analog (training reads go through ingest/)."""
+        return self._repo_dao("EVENTDATA", "Events")
+
+    def verify_all_data_objects(self) -> bool:
+        """Smoke-test every repository (Storage.scala:370-392)."""
+        self.get_meta_data_apps()
+        self.get_meta_data_access_keys()
+        self.get_meta_data_channels()
+        self.get_meta_data_engine_instances()
+        self.get_meta_data_evaluation_instances()
+        self.get_model_data_models()
+        events = self.get_events()
+        events.init(0)
+        events.remove(0)
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._clients.values():
+                close = getattr(client, "close", None)
+                if close:
+                    close()
+            self._clients.clear()
+            self._daos.clear()
+
+
+_default: Optional[StorageRegistry] = None
+_default_lock = threading.Lock()
+
+
+def storage(refresh: bool = False) -> StorageRegistry:
+    """The process-wide default registry, built from env on first use."""
+    global _default
+    with _default_lock:
+        if _default is None or refresh:
+            _default = StorageRegistry()
+        return _default
+
+
+def set_default(registry: Optional[StorageRegistry]) -> None:
+    """Install (or clear) the process-default registry; used by tests/CLI."""
+    global _default
+    with _default_lock:
+        _default = registry

@@ -1,0 +1,547 @@
+"""SQLite storage driver ("SQLITE" type) — the default persistent backend.
+
+Plays the role of the reference's JDBC driver
+(`storage/jdbc/src/main/scala/.../JDBC{LEvents,Models,...}.scala`): one SQL
+backend implementing every DAO, with per-(app,channel) event tables named
+`events_<appId>[_<channelId>]` (mirroring JDBCUtils.eventTableName).
+
+A single serialized connection guarded by an RLock keeps this correct under
+the threaded HTTP servers; SQLite WAL mode keeps readers unblocked.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from datetime import datetime
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import (
+    DataMap, Event, from_millis, to_millis,
+)
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model, _UNSET,
+)
+
+
+class SQLiteStorageClient:
+    """Owns the sqlite connection; all DAOs of a source share one client."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        path = self.config.get("PATH", self.config.get("path", ":memory:"))
+        if path != ":memory:":
+            path = str(Path(path).expanduser())
+        self.path = path
+        self.lock = threading.RLock()
+        self.conn = sqlite3.connect(self.path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA synchronous=NORMAL")
+        self._init_meta_tables()
+
+    def _init_meta_tables(self) -> None:
+        with self.lock, self.conn:
+            c = self.conn
+            c.execute("""CREATE TABLE IF NOT EXISTS apps (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL UNIQUE,
+                description TEXT)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS access_keys (
+                accesskey TEXT PRIMARY KEY,
+                appid INTEGER NOT NULL,
+                events TEXT NOT NULL)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS channels (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL,
+                appid INTEGER NOT NULL)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS engine_instances (
+                id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
+                endtime INTEGER, engineid TEXT, engineversion TEXT,
+                enginevariant TEXT, enginefactory TEXT, batch TEXT,
+                env TEXT, runtimeconf TEXT, datasourceparams TEXT,
+                preparatorparams TEXT, algorithmsparams TEXT,
+                servingparams TEXT)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS evaluation_instances (
+                id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
+                endtime INTEGER, evaluationclass TEXT,
+                engineparamsgeneratorclass TEXT, batch TEXT, env TEXT,
+                runtimeconf TEXT, evaluatorresults TEXT,
+                evaluatorresultshtml TEXT, evaluatorresultsjson TEXT)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS models (
+                id TEXT PRIMARY KEY, models BLOB)""")
+
+    def close(self) -> None:
+        with self.lock:
+            self.conn.close()
+
+
+def event_table_name(app_id: int, channel_id: Optional[int]) -> str:
+    """`events_<appId>[_<channelId>]` (JDBCUtils.eventTableName)."""
+    return f"events_{app_id}" + (f"_{channel_id}" if channel_id is not None else "")
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def insert(self, app: App) -> Optional[int]:
+        try:
+            with self.c.lock, self.c.conn:
+                if app.id:
+                    self.c.conn.execute(
+                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                        (app.id, app.name, app.description))
+                    return app.id
+                cur = self.c.conn.execute(
+                    "INSERT INTO apps (name, description) VALUES (?,?)",
+                    (app.name, app.description))
+                return cur.lastrowid
+        except sqlite3.IntegrityError as ex:
+            raise base.StorageWriteError(
+                f"App name {app.name!r} already exists") from ex
+
+    def get(self, app_id: int) -> Optional[App]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT id, name, description FROM apps WHERE id=?",
+                (app_id,)).fetchone()
+        return App(*row) if row else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT id, name, description FROM apps WHERE name=?",
+                (name,)).fetchone()
+        return App(*row) if row else None
+
+    def get_all(self) -> List[App]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id, name, description FROM apps ORDER BY id").fetchall()
+        return [App(*r) for r in rows]
+
+    def update(self, app: App) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "UPDATE apps SET name=?, description=? WHERE id=?",
+                (app.name, app.description, app.id))
+
+    def delete(self, app_id: int) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute("DELETE FROM apps WHERE id=?", (app_id,))
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        key = k.key or self.generate_key()
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "INSERT INTO access_keys (accesskey, appid, events) VALUES (?,?,?)",
+                (key, k.appid, json.dumps(list(k.events))))
+        return key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT accesskey, appid, events FROM access_keys "
+                "WHERE accesskey=?", (key,)).fetchone()
+        return AccessKey(row[0], row[1], tuple(json.loads(row[2]))) if row else None
+
+    def get_all(self) -> List[AccessKey]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT accesskey, appid, events FROM access_keys").fetchall()
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2]))) for r in rows]
+
+    def get_by_appid(self, appid: int) -> List[AccessKey]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT accesskey, appid, events FROM access_keys WHERE appid=?",
+                (appid,)).fetchall()
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2]))) for r in rows]
+
+    def update(self, k: AccessKey) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "UPDATE access_keys SET appid=?, events=? WHERE accesskey=?",
+                (k.appid, json.dumps(list(k.events)), k.key))
+
+    def delete(self, key: str) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "DELETE FROM access_keys WHERE accesskey=?", (key,))
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        with self.c.lock, self.c.conn:
+            if channel.id:
+                self.c.conn.execute(
+                    "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.appid))
+                return channel.id
+            cur = self.c.conn.execute(
+                "INSERT INTO channels (name, appid) VALUES (?,?)",
+                (channel.name, channel.appid))
+            return cur.lastrowid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT id, name, appid FROM channels WHERE id=?",
+                (channel_id,)).fetchone()
+        return Channel(*row) if row else None
+
+    def get_by_appid(self, appid: int) -> List[Channel]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id, name, appid FROM channels WHERE appid=? ORDER BY id",
+                (appid,)).fetchall()
+        return [Channel(*r) for r in rows]
+
+    def delete(self, channel_id: int) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    COLS = ("id, status, starttime, endtime, engineid, engineversion, "
+            "enginevariant, enginefactory, batch, env, runtimeconf, "
+            "datasourceparams, preparatorparams, algorithmsparams, servingparams")
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def _to_row(self, i: EngineInstance):
+        return (i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
+                i.engine_id, i.engine_version, i.engine_variant,
+                i.engine_factory, i.batch, json.dumps(dict(i.env)),
+                json.dumps(dict(i.runtime_conf)), i.data_source_params,
+                i.preparator_params, i.algorithms_params, i.serving_params)
+
+    @staticmethod
+    def _from_row(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0], status=r[1], start_time=from_millis(r[2]),
+            end_time=from_millis(r[3]), engine_id=r[4], engine_version=r[5],
+            engine_variant=r[6], engine_factory=r[7], batch=r[8],
+            env=json.loads(r[9]), runtime_conf=json.loads(r[10]),
+            data_source_params=r[11], preparator_params=r[12],
+            algorithms_params=r[13], serving_params=r[14])
+
+    def insert(self, i: EngineInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        i = i.with_(id=iid)
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                f"INSERT INTO engine_instances ({self.COLS}) VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)", self._to_row(i))
+        return iid
+
+    def get(self, iid: str) -> Optional[EngineInstance]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM engine_instances WHERE id=?",
+                (iid,)).fetchone()
+        return self._from_row(row) if row else None
+
+    def get_all(self) -> List[EngineInstance]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM engine_instances").fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM engine_instances WHERE status=? AND "
+                "engineid=? AND engineversion=? AND enginevariant=? "
+                "ORDER BY starttime DESC",
+                (base.EngineInstanceStatus.COMPLETED, engine_id,
+                 engine_version, engine_variant)).fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        rows = self.get_completed(engine_id, engine_version, engine_variant)
+        return rows[0] if rows else None
+
+    def update(self, i: EngineInstance) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "UPDATE engine_instances SET status=?, starttime=?, endtime=?, "
+                "engineid=?, engineversion=?, enginevariant=?, enginefactory=?, "
+                "batch=?, env=?, runtimeconf=?, datasourceparams=?, "
+                "preparatorparams=?, algorithmsparams=?, servingparams=? "
+                "WHERE id=?", self._to_row(i)[1:] + (i.id,))
+
+    def delete(self, iid: str) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute("DELETE FROM engine_instances WHERE id=?", (iid,))
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    COLS = ("id, status, starttime, endtime, evaluationclass, "
+            "engineparamsgeneratorclass, batch, env, runtimeconf, "
+            "evaluatorresults, evaluatorresultshtml, evaluatorresultsjson")
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def _to_row(self, i: EvaluationInstance):
+        return (i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
+                i.evaluation_class, i.engine_params_generator_class, i.batch,
+                json.dumps(dict(i.env)), json.dumps(dict(i.runtime_conf)),
+                i.evaluator_results, i.evaluator_results_html,
+                i.evaluator_results_json)
+
+    @staticmethod
+    def _from_row(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0], status=r[1], start_time=from_millis(r[2]),
+            end_time=from_millis(r[3]), evaluation_class=r[4],
+            engine_params_generator_class=r[5], batch=r[6],
+            env=json.loads(r[7]), runtime_conf=json.loads(r[8]),
+            evaluator_results=r[9], evaluator_results_html=r[10],
+            evaluator_results_json=r[11])
+
+    def insert(self, i: EvaluationInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        i = i.with_(id=iid)
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                f"INSERT INTO evaluation_instances ({self.COLS}) VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)", self._to_row(i))
+        return iid
+
+    def get(self, iid: str) -> Optional[EvaluationInstance]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM evaluation_instances WHERE id=?",
+                (iid,)).fetchone()
+        return self._from_row(row) if row else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM evaluation_instances").fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self.COLS} FROM evaluation_instances WHERE status=? "
+                "ORDER BY starttime DESC",
+                (base.EvaluationInstanceStatus.COMPLETED,)).fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def update(self, i: EvaluationInstance) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "UPDATE evaluation_instances SET status=?, starttime=?, "
+                "endtime=?, evaluationclass=?, engineparamsgeneratorclass=?, "
+                "batch=?, env=?, runtimeconf=?, evaluatorresults=?, "
+                "evaluatorresultshtml=?, evaluatorresultsjson=? WHERE id=?",
+                self._to_row(i)[1:] + (i.id,))
+
+    def delete(self, iid: str) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "DELETE FROM evaluation_instances WHERE id=?", (iid,))
+
+
+class SQLiteModels(base.Models):
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    def insert(self, m: Model) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
+                (m.id, m.models))
+
+    def get(self, mid: str) -> Optional[Model]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT id, models FROM models WHERE id=?", (mid,)).fetchone()
+        return Model(row[0], row[1]) if row else None
+
+    def delete(self, mid: str) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute("DELETE FROM models WHERE id=?", (mid,))
+
+
+class SQLiteEvents(base.EventStore):
+    """Event store over per-(app,channel) tables (JDBCLEvents.scala:37-120).
+
+    Tables are created lazily on first access so behavior matches the MEM
+    driver on the uninitialized path.
+    """
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+        self._known: set = set()
+
+    def _ensure(self, app_id: int, channel_id: Optional[int]) -> None:
+        if (app_id, channel_id) not in self._known:
+            self.init(app_id, channel_id)
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        t = event_table_name(app_id, channel_id)
+        self._known.add((app_id, channel_id))
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(f"""CREATE TABLE IF NOT EXISTS {t} (
+                id TEXT PRIMARY KEY,
+                event TEXT NOT NULL,
+                entitytype TEXT NOT NULL,
+                entityid TEXT NOT NULL,
+                targetentitytype TEXT,
+                targetentityid TEXT,
+                properties TEXT,
+                eventtime INTEGER NOT NULL,
+                tags TEXT,
+                prid TEXT,
+                creationtime INTEGER NOT NULL)""")
+            self.c.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} "
+                "(entitytype, entityid)")
+            self.c.conn.execute(
+                f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (eventtime)")
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        t = event_table_name(app_id, channel_id)
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(f"DROP TABLE IF EXISTS {t}")
+        self._known.discard((app_id, channel_id))
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def _insert(self, event: Event, app_id: int,
+                channel_id: Optional[int] = None) -> str:
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        e = event if event.event_id else event.with_id()
+        try:
+            with self.c.lock, self.c.conn:
+                self.c.conn.execute(
+                    f"INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    (e.event_id, e.event, e.entity_type, e.entity_id,
+                     e.target_entity_type, e.target_entity_id,
+                     e.properties.to_json(), to_millis(e.event_time),
+                     json.dumps(list(e.tags)), e.pr_id,
+                     to_millis(e.creation_time)))
+        except sqlite3.IntegrityError as ex:
+            raise base.StorageWriteError(str(ex)) from ex
+        return e.event_id
+
+    def _insert_batch(self, events: Sequence[Event], app_id: int,
+                      channel_id: Optional[int] = None) -> List[str]:
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        out, rows = [], []
+        for event in events:
+            e = event if event.event_id else event.with_id()
+            out.append(e.event_id)
+            rows.append((e.event_id, e.event, e.entity_type, e.entity_id,
+                         e.target_entity_type, e.target_entity_id,
+                         e.properties.to_json(), to_millis(e.event_time),
+                         json.dumps(list(e.tags)), e.pr_id,
+                         to_millis(e.creation_time)))
+        try:
+            with self.c.lock, self.c.conn:
+                self.c.conn.executemany(
+                    f"INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+        except sqlite3.IntegrityError as ex:
+            raise base.StorageWriteError(str(ex)) from ex
+        return out
+
+    @staticmethod
+    def _row_to_event(r) -> Event:
+        return Event(
+            event_id=r[0], event=r[1], entity_type=r[2], entity_id=r[3],
+            target_entity_type=r[4], target_entity_id=r[5],
+            properties=DataMap.from_json(r[6] or "{}"),
+            event_time=from_millis(r[7]),
+            tags=tuple(json.loads(r[8] or "[]")), pr_id=r[9],
+            creation_time=from_millis(r[10]))
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        with self.c.lock:
+            row = self.c.conn.execute(
+                f"SELECT * FROM {t} WHERE id=?", (event_id,)).fetchone()
+        return self._row_to_event(row) if row else None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        with self.c.lock, self.c.conn:
+            cur = self.c.conn.execute(
+                f"DELETE FROM {t} WHERE id=?", (event_id,))
+            return cur.rowcount > 0
+
+    def find(self, app_id: int, channel_id: Optional[int] = None, *,
+             start_time: Optional[datetime] = None,
+             until_time: Optional[datetime] = None,
+             entity_type: Optional[str] = None,
+             entity_id: Optional[str] = None,
+             event_names: Optional[Sequence[str]] = None,
+             target_entity_type: object = _UNSET,
+             target_entity_id: object = _UNSET,
+             limit: Optional[int] = None,
+             reversed: bool = False) -> Iterator[Event]:
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        clauses, params = [], []
+        if start_time is not None:
+            clauses.append("eventtime >= ?")
+            params.append(to_millis(start_time))
+        if until_time is not None:
+            clauses.append("eventtime < ?")
+            params.append(to_millis(until_time))
+        if entity_type is not None:
+            clauses.append("entitytype = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entityid = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            names = list(event_names)
+            clauses.append(
+                "event IN (" + ",".join("?" * len(names)) + ")")
+            params.extend(names)
+        if target_entity_type is not _UNSET:
+            if target_entity_type is None:
+                clauses.append("targetentitytype IS NULL")
+            else:
+                clauses.append("targetentitytype = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not _UNSET:
+            if target_entity_id is None:
+                clauses.append("targetentityid IS NULL")
+            else:
+                clauses.append("targetentityid = ?")
+                params.append(target_entity_id)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = " ORDER BY eventtime DESC, id DESC" if reversed \
+            else " ORDER BY eventtime ASC, id ASC"
+        lim = f" LIMIT {int(limit)}" if limit is not None and limit > 0 else ""
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT * FROM {t}{where}{order}{lim}", params).fetchall()
+        return iter([self._row_to_event(r) for r in rows])

@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh (the analog of
+the reference testing Spark code on a `local[*]` master,
+`core/.../workflow/BaseTest.scala:28-141`): JAX must see the flags before
+first initialization, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mem_registry():
+    """A fresh all-in-memory storage registry, installed as process default."""
+    from predictionio_tpu.data.storage import StorageRegistry, set_default
+
+    reg = StorageRegistry({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    set_default(reg)
+    yield reg
+    set_default(None)

@@ -1,0 +1,178 @@
+"""$set/$unset/$delete aggregation tests.
+
+Mirrors reference `data/src/test/scala/.../{L,P}EventAggregatorSpec.scala`
+(last-write-wins, unset/delete tie-breaking) plus a randomized
+monoid-vs-sequential equivalence property: combining EventOps in any order
+must equal the sequential time-ordered replay — this is what licenses the
+parallel tree-reduce over event shards in the TPU ingestion path.
+"""
+
+import random
+from datetime import datetime, timezone, timedelta
+
+from predictionio_tpu.data import DataMap, Event, EventOp, aggregate_properties
+from predictionio_tpu.data.aggregate import aggregate_properties_single
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def at(minutes):
+    return T0 + timedelta(minutes=minutes)
+
+
+def set_(eid, props, t):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=at(t))
+
+
+def unset(eid, keys, t):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=at(t))
+
+
+def delete(eid, t):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=at(t))
+
+
+def plain(eid, t):
+    return Event(event="view", entity_type="user", entity_id=eid,
+                 event_time=at(t))
+
+
+class TestAggregation:
+    def test_last_write_wins(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1, "b": 1}, 0),
+            set_("u1", {"a": 2}, 10),
+            set_("u1", {"b": 0}, 5),
+        ])
+        pm = out["u1"]
+        assert pm.fields == DataMap({"a": 2, "b": 0})
+        assert pm.first_updated == at(0)
+        assert pm.last_updated == at(10)
+
+    def test_unset_removes_only_older_sets(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1, "b": 1}, 0),
+            unset("u1", ["a"], 5),
+            set_("u1", {"a": 3}, 10),
+        ])
+        assert out["u1"].fields == DataMap({"a": 3, "b": 1})
+
+    def test_unset_wins_tie_with_set(self):
+        # $unset at the same millis wins (`v >= set.fields(k).t`); the entity
+        # itself survives (a $set happened) but with empty fields.
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 5),
+            unset("u1", ["a"], 5),
+        ])
+        assert out["u1"].fields == DataMap({})
+
+    def test_unset_tie_leaves_entity_with_remaining_fields(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1, "b": 2}, 5),
+            unset("u1", ["a"], 5),
+        ])
+        assert out["u1"].fields == DataMap({"b": 2})
+
+    def test_delete_removes_entity(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 0),
+            delete("u1", 5),
+        ])
+        assert "u1" not in out
+
+    def test_delete_tie_wins_over_set(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 5),
+            delete("u1", 5),
+        ])
+        assert "u1" not in out
+
+    def test_set_after_delete_recreates(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1, "b": 2}, 0),
+            delete("u1", 5),
+            set_("u1", {"a": 9}, 10),
+        ])
+        assert out["u1"].fields == DataMap({"a": 9})
+
+    def test_never_set_entity_absent(self):
+        out = aggregate_properties([plain("u1", 0), unset("u2", ["x"], 1)])
+        assert out == {}
+
+    def test_plain_events_ignored(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 0), plain("u1", 100)])
+        assert out["u1"].fields == DataMap({"a": 1})
+        assert out["u1"].last_updated == at(0)
+
+    def test_multiple_entities(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 0), set_("u2", {"a": 2}, 0)])
+        assert set(out) == {"u1", "u2"}
+
+
+class TestMonoidProperties:
+    def _random_events(self, rng, n):
+        events = []
+        for _ in range(n):
+            t = rng.randrange(0, 50)
+            kind = rng.choice(["set", "set", "set", "unset", "delete", "plain"])
+            keys = rng.sample("abcde", rng.randrange(1, 4))
+            if kind == "set":
+                events.append(set_("u", {k: rng.randrange(10) for k in keys}, t))
+            elif kind == "unset":
+                events.append(unset("u", keys, t))
+            elif kind == "delete":
+                events.append(delete("u", t))
+            else:
+                events.append(plain("u", t))
+        return events
+
+    def test_combine_order_independent(self):
+        """Tree-reduce in any order == sequential replay (up to same-millis
+        value ties, avoided by using distinct timestamps per kind)."""
+        rng = random.Random(42)
+        for trial in range(200):
+            # distinct timestamps so results are order-deterministic
+            n = rng.randrange(1, 12)
+            times = rng.sample(range(1000), n)
+            events = []
+            for t in times:
+                kind = rng.choice(["set", "set", "unset", "delete"])
+                keys = rng.sample("abc", rng.randrange(1, 3))
+                if kind == "set":
+                    events.append(set_("u", {k: t for k in keys}, t))
+                elif kind == "unset":
+                    events.append(unset("u", keys, t))
+                else:
+                    events.append(delete("u", t))
+            # sequential replay in time order
+            seq = aggregate_properties_single(
+                sorted(events, key=lambda e: e.event_time))
+            # monoid combine in shuffled order
+            shuffled = events[:]
+            rng.shuffle(shuffled)
+            acc = EventOp()
+            for e in shuffled:
+                acc = acc.combine(EventOp.from_event(e))
+            mon = acc.to_property_map()
+            if seq is None:
+                assert mon is None, f"trial {trial}"
+            else:
+                assert mon is not None, f"trial {trial}"
+                assert mon.fields == seq.fields, f"trial {trial}"
+                assert mon.first_updated == seq.first_updated
+                assert mon.last_updated == seq.last_updated
+
+    def test_associativity(self):
+        rng = random.Random(7)
+        for _ in range(100):
+            a, b, c = (EventOp.from_event(e)
+                       for e in self._random_events(rng, 3))
+            left = a.combine(b).combine(c)
+            right = a.combine(b.combine(c))
+            assert left.combine(EventOp()) == right
+            assert a.combine(b) == b.combine(a)  # commutativity

@@ -1,0 +1,123 @@
+"""Event model + validation tests (reference: EventTest-adjacent rules in
+`data/.../storage/Event.scala:68-166`, DataMap behavior from
+`data/src/test/scala/.../DataMapSpec.scala`)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event, EventValidation
+from predictionio_tpu.data.event import format_time, parse_time, to_millis
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+class TestValidation:
+    def test_valid_plain_event(self):
+        EventValidation.validate(ev(
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({"rating": 4.5})))
+
+    @pytest.mark.parametrize("kw", [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type=""),
+        dict(target_entity_id="", target_entity_type="item"),
+        dict(target_entity_type="item"),           # target type without id
+        dict(target_entity_id="i1"),               # target id without type
+        dict(event="$unset"),                      # $unset with no properties
+        dict(event="$custom"),                     # reserved prefix, not special
+        dict(event="pio_thing"),
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_users"),
+        dict(target_entity_type="pio_x", target_entity_id="i1"),
+        dict(properties=DataMap({"pio_score": 1})),
+    ])
+    def test_invalid(self, kw):
+        with pytest.raises(ValueError):
+            EventValidation.validate(ev(**kw))
+
+    def test_builtin_entity_type_allowed(self):
+        EventValidation.validate(ev(entity_type="pio_pr"))
+
+    def test_special_events_ok(self):
+        EventValidation.validate(ev(event="$set", properties=DataMap({"a": 1})))
+        EventValidation.validate(ev(event="$unset", properties=DataMap({"a": None})))
+        EventValidation.validate(ev(event="$delete"))
+
+
+class TestDataMap:
+    def test_typed_get(self):
+        d = DataMap({"a": 1, "b": "x", "c": 2.5, "d": [1, 2], "e": None,
+                     "f": True})
+        assert d.get("a", int) == 1
+        assert d.get("a", float) == 1.0
+        assert d.get("b", str) == "x"
+        assert d.get("c", float) == 2.5
+        assert d.get("d", list) == [1, 2]
+        assert d.get("f", bool) is True
+        with pytest.raises(KeyError):
+            d.get("missing")
+        with pytest.raises(ValueError):
+            d.get("e")          # null in a required get
+        assert d.get_opt("e") is None
+        assert d.get_opt("missing") is None
+        assert d.get_or_else("missing", 7) == 7
+        with pytest.raises(TypeError):
+            d.get("b", int)
+
+    def test_bool_is_not_int(self):
+        d = DataMap({"f": True})
+        with pytest.raises(TypeError):
+            d.get("f", int)
+
+    def test_merge_and_remove(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.merge(b) == DataMap({"x": 1, "y": 3, "z": 4})
+        assert a.remove(["x"]) == DataMap({"y": 2})
+
+    def test_json_roundtrip(self):
+        d = DataMap({"a": [1, "x", {"n": None}], "b": {"c": 1.5}})
+        assert DataMap.from_json(d.to_json()) == d
+
+    def test_rejects_non_json(self):
+        with pytest.raises(ValueError):
+            DataMap({"a": object()})
+
+
+class TestEventJson:
+    def test_roundtrip(self):
+        e = ev(target_entity_type="item", target_entity_id="i1",
+               properties=DataMap({"rating": 4.0}),
+               event_time=datetime(2020, 5, 1, 12, 30, 0, 250000,
+                                   tzinfo=timezone.utc),
+               tags=("a", "b"), pr_id="pr1").with_id("e1")
+        e2 = Event.from_api_json(e.to_api_json())
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == "i1"
+        assert e2.properties == e.properties
+        assert to_millis(e2.event_time) == to_millis(e.event_time)
+        assert tuple(e2.tags) == ("a", "b")
+        assert e2.pr_id == "pr1"
+        assert e2.event_id == "e1"
+
+    def test_from_json_validates(self):
+        with pytest.raises(ValueError):
+            Event.from_api_json({"event": "$bad", "entityType": "user",
+                                 "entityId": "u1"})
+        with pytest.raises(ValueError):
+            Event.from_api_json({"entityType": "user", "entityId": "u1"})
+
+    def test_time_parsing(self):
+        t = parse_time("2020-05-01T12:30:00.250Z")
+        assert t.tzinfo is not None
+        assert format_time(t) == "2020-05-01T12:30:00.250Z"
+        t2 = parse_time("2020-05-01T08:30:00.250-04:00")
+        assert to_millis(t2) == to_millis(t)

@@ -1,0 +1,237 @@
+"""Storage contract suite, run against every driver.
+
+Mirrors the reference's approach of running one behavioral contract against
+each backend (`storage/{jdbc,hbase}/src/test/.../{LEventsSpec,PEventsSpec}.scala`
++ shared corpus `TestEvents.scala`): init/insert/get/delete/find filters/
+aggregate/remove, plus the metadata DAO contracts.
+"""
+
+import tempfile
+from datetime import datetime, timedelta, timezone
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import (
+    AccessKey, App, Channel, EngineInstance, Model, StorageRegistry,
+    StorageWriteError,
+)
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def make_registry(kind: str, tmpdir: str) -> StorageRegistry:
+    if kind == "MEM":
+        cfg = {"PIO_STORAGE_SOURCES_MEM_TYPE": "MEM"}
+        src = "MEM"
+    elif kind == "SQLITE":
+        cfg = {"PIO_STORAGE_SOURCES_SQLITE_TYPE": "SQLITE",
+               "PIO_STORAGE_SOURCES_SQLITE_PATH": str(Path(tmpdir) / "pio.db")}
+        src = "SQLITE"
+    elif kind == "SQLITE+LOCALFS":
+        cfg = {"PIO_STORAGE_SOURCES_SQLITE_TYPE": "SQLITE",
+               "PIO_STORAGE_SOURCES_SQLITE_PATH": str(Path(tmpdir) / "pio.db"),
+               "PIO_STORAGE_SOURCES_FS_TYPE": "LOCALFS",
+               "PIO_STORAGE_SOURCES_FS_PATH": str(Path(tmpdir) / "models"),
+               "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS"}
+        src = "SQLITE"
+    for repo in ("METADATA", "EVENTDATA"):
+        cfg.setdefault(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", src)
+    return StorageRegistry(cfg)
+
+
+@pytest.fixture(params=["MEM", "SQLITE", "SQLITE+LOCALFS"])
+def registry(request):
+    with tempfile.TemporaryDirectory() as d:
+        reg = make_registry(request.param, d)
+        yield reg
+        reg.close()
+
+
+def ev(event="view", eid="u1", etype="user", t=0, props=None, target=None,
+       **kw):
+    return Event(
+        event=event, entity_type=etype, entity_id=eid,
+        target_entity_type=target[0] if target else None,
+        target_entity_id=target[1] if target else None,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=t), **kw)
+
+
+class TestEventStoreContract:
+    def test_insert_get_delete(self, registry):
+        es = registry.get_events()
+        es.init(1)
+        eid = es.insert(ev(), 1)
+        got = es.get(eid, 1)
+        assert got is not None and got.event == "view"
+        assert es.delete(eid, 1) is True
+        assert es.get(eid, 1) is None
+        assert es.delete(eid, 1) is False
+
+    def test_channel_isolation(self, registry):
+        es = registry.get_events()
+        es.init(1)
+        es.init(1, 7)
+        es.insert(ev(eid="a"), 1)
+        es.insert(ev(eid="b"), 1, 7)
+        assert [e.entity_id for e in es.find(1)] == ["a"]
+        assert [e.entity_id for e in es.find(1, 7)] == ["b"]
+
+    def test_find_filters(self, registry):
+        es = registry.get_events()
+        es.init(2)
+        es.insert(ev(event="view", eid="u1", t=0), 2)
+        es.insert(ev(event="buy", eid="u1", t=10,
+                     target=("item", "i1")), 2)
+        es.insert(ev(event="view", eid="u2", t=20), 2)
+        es.insert(ev(event="rate", eid="u1", etype="customer", t=30), 2)
+
+        assert len(list(es.find(2))) == 4
+        assert len(list(es.find(2, event_names=["view"]))) == 2
+        assert len(list(es.find(2, entity_type="user"))) == 3
+        assert [e.event for e in es.find(2, entity_type="user",
+                                         entity_id="u1")] == ["view", "buy"]
+        # time range: start inclusive, until exclusive
+        got = list(es.find(2, start_time=T0 + timedelta(minutes=10),
+                           until_time=T0 + timedelta(minutes=30)))
+        assert [e.event for e in got] == ["buy", "view"]
+        # target entity three-state filter
+        assert [e.event for e in es.find(2, target_entity_type="item")] == ["buy"]
+        assert len(list(es.find(2, target_entity_type=None))) == 3
+        # limit + reversed
+        assert [e.event for e in es.find(2, limit=2)] == ["view", "buy"]
+        got = [e.event for e in es.find(2, entity_type="user", entity_id="u1",
+                                        reversed=True, limit=1)]
+        assert got == ["buy"]
+
+    def test_ordering_by_time(self, registry):
+        es = registry.get_events()
+        es.init(3)
+        for t in (5, 1, 3):
+            es.insert(ev(eid=f"u{t}", t=t), 3)
+        assert [e.entity_id for e in es.find(3)] == ["u1", "u3", "u5"]
+
+    def test_insert_batch(self, registry):
+        es = registry.get_events()
+        es.init(4)
+        ids = es.insert_batch([ev(eid="a"), ev(eid="b")], 4)
+        assert len(ids) == 2
+        assert len(list(es.find(4))) == 2
+
+    def test_aggregate_properties(self, registry):
+        es = registry.get_events()
+        es.init(5)
+        es.insert(ev(event="$set", eid="u1", t=0,
+                     props={"a": 1, "plan": "x"}), 5)
+        es.insert(ev(event="$set", eid="u1", t=5, props={"a": 2}), 5)
+        es.insert(ev(event="$unset", eid="u1", t=6, props={"plan": None}), 5)
+        es.insert(ev(event="$set", eid="u2", t=0, props={"a": 9}), 5)
+        es.insert(ev(event="$delete", eid="u2", t=1), 5)
+        es.insert(ev(event="view", eid="u1", t=9), 5)
+        agg = es.aggregate_properties(5, entity_type="user")
+        assert set(agg) == {"u1"}
+        assert agg["u1"].fields == DataMap({"a": 2})
+        one = es.aggregate_properties_of_entity(
+            5, entity_type="user", entity_id="u1")
+        assert one is not None and one.fields == DataMap({"a": 2})
+
+    def test_insert_validates(self, registry):
+        es = registry.get_events()
+        es.init(7)
+        with pytest.raises(ValueError):
+            es.insert(ev(event="$unset"), 7)  # empty props forbidden
+        with pytest.raises(ValueError):
+            es.insert(Event(event="view", entity_type="user", entity_id=""), 7)
+
+    def test_duplicate_event_id_rejected(self, registry):
+        es = registry.get_events()
+        es.init(8)
+        e = ev().with_id("dup")
+        es.insert(e, 8)
+        with pytest.raises(StorageWriteError):
+            es.insert(e, 8)
+
+    def test_uninitialized_app_behaves_like_empty(self, registry):
+        es = registry.get_events()
+        assert list(es.find(404)) == []
+        eid = es.insert(ev(), 405)  # lazily initializes
+        assert es.get(eid, 405) is not None
+
+    def test_remove(self, registry):
+        es = registry.get_events()
+        es.init(6)
+        es.insert(ev(), 6)
+        es.remove(6)
+        es.init(6)
+        assert list(es.find(6)) == []
+
+
+class TestMetaDAOs:
+    def test_apps(self, registry):
+        apps = registry.get_meta_data_apps()
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid and apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        apps.update(App(aid, "myapp", "newdesc"))
+        assert apps.get(aid).description == "newdesc"
+        assert len(apps.get_all()) == 1
+        with pytest.raises(StorageWriteError):
+            apps.insert(App(0, "myapp", None))  # names are unique
+        apps.delete(aid)
+        assert apps.get(aid) is None
+
+    def test_access_keys(self, registry):
+        aks = registry.get_meta_data_access_keys()
+        key = aks.insert(AccessKey("", 1, ()))
+        assert key and len(key) >= 40 and not key.startswith("-")
+        assert aks.get(key).appid == 1
+        aks.insert(AccessKey("fixed-key", 2, ("view", "buy")))
+        assert aks.get("fixed-key").events == ("view", "buy")
+        assert {k.key for k in aks.get_by_appid(2)} == {"fixed-key"}
+        aks.delete(key)
+        assert aks.get(key) is None
+
+    def test_channels(self, registry):
+        chs = registry.get_meta_data_channels()
+        cid = chs.insert(Channel(0, "mobile", 1))
+        assert chs.get(cid).name == "mobile"
+        assert [c.name for c in chs.get_by_appid(1)] == ["mobile"]
+        chs.delete(cid)
+        assert chs.get(cid) is None
+        with pytest.raises(ValueError):
+            Channel(0, "bad name!", 1)
+        with pytest.raises(ValueError):
+            Channel(0, "x" * 17, 1)
+
+    def test_engine_instances(self, registry):
+        eis = registry.get_meta_data_engine_instances()
+        base = EngineInstance(
+            status="INIT", engine_id="rec", engine_version="1",
+            engine_variant="default", engine_factory="f",
+            env={"K": "V"}, algorithms_params='[{"als": {}}]')
+        iid = eis.insert(base)
+        got = eis.get(iid)
+        assert got.status == "INIT" and dict(got.env) == {"K": "V"}
+        eis.update(got.with_(status="COMPLETED"))
+        latest = eis.get_latest_completed("rec", "1", "default")
+        assert latest is not None and latest.id == iid
+        # newer completed instance wins
+        iid2 = eis.insert(base.with_(
+            status="COMPLETED",
+            start_time=base.start_time + timedelta(hours=1)))
+        assert eis.get_latest_completed("rec", "1", "default").id == iid2
+        assert eis.get_latest_completed("other", "1", "default") is None
+        eis.delete(iid)
+        assert eis.get(iid) is None
+
+    def test_models(self, registry):
+        models = registry.get_model_data_models()
+        models.insert(Model("m1", b"\x00\x01binary"))
+        assert models.get("m1").models == b"\x00\x01binary"
+        models.delete("m1")
+        assert models.get("m1") is None
+
+    def test_verify_all(self, registry):
+        assert registry.verify_all_data_objects() is True
