@@ -10,6 +10,9 @@ Behavioral parity with the reference's two aggregators:
 Both produce identical results; the monoid form is what lets the TPU build
 aggregate event shards in parallel (tree-reduce over shards) without a
 Spark-style shuffle. Tie-breaking matches the reference exactly:
+  - between two $set of the same key at the same timestamp, the right
+    combine operand wins (reference SetProp.++ keeps `that` on ties), so a
+    fold in time-sorted order equals sequential replay
   - $unset wins over $set at the same timestamp (`v >= set.fields(k).t`)
   - $delete wins over $set at the same timestamp (`delete.t >= set.t`)
 """
@@ -59,10 +62,14 @@ class EventOp:
         return EventOp()
 
     def combine(self, other: "EventOp") -> "EventOp":
-        """Associative, commutative combine (`EventOp.++`)."""
+        """Associative combine (`EventOp.++`); commutative up to equal-time
+        ties, which are resolved right-biased exactly like the reference's
+        `SetProp.++` (`if (thisData.t > thatData.t) thisData else thatData`,
+        PEventAggregator.scala) — so a left-to-right fold matches the
+        sequential time-sorted replay."""
         set_fields: Dict[str, Tuple[object, int]] = dict(self.set_fields)
         for k, (v, t) in other.set_fields.items():
-            if k not in set_fields or t > set_fields[k][1]:
+            if k not in set_fields or t >= set_fields[k][1]:
                 set_fields[k] = (v, t)
         unset_fields: Dict[str, int] = dict(self.unset_fields)
         for k, t in other.unset_fields.items():

@@ -283,6 +283,13 @@ class Event:
         props = obj.get("properties") or {}
         if not isinstance(props, Mapping):
             raise ValueError("properties must be an object")
+        for name in ("targetEntityType", "targetEntityId", "prId", "eventId"):
+            if obj.get(name) is not None and not isinstance(obj[name], str):
+                raise ValueError(f"field {name} must be a string")
+        tags = obj.get("tags") or ()
+        if not isinstance(tags, (list, tuple)) or not all(
+                isinstance(t, str) for t in tags):
+            raise ValueError("field tags must be an array of strings")
         event_time = (parse_time(obj["eventTime"]) if "eventTime" in obj
                       and obj["eventTime"] is not None else utcnow())
         e = Event(
@@ -293,7 +300,7 @@ class Event:
             target_entity_id=obj.get("targetEntityId"),
             properties=DataMap(props),
             event_time=event_time,
-            tags=tuple(obj.get("tags") or ()),
+            tags=tuple(tags),
             pr_id=obj.get("prId"),
             creation_time=(parse_time(obj["creationTime"])
                            if obj.get("creationTime") else utcnow()),

@@ -48,6 +48,8 @@ class MemApps(base.Apps):
             if any(a.name == app.name for a in self.c.apps.values()):
                 raise base.StorageWriteError(
                     f"App name {app.name!r} already exists")
+            if app.id and app.id in self.c.apps:
+                raise base.StorageWriteError(f"App id {app.id} already exists")
             app_id = app.id or next(self.c._app_seq)
             while app.id == 0 and app_id in self.c.apps:
                 app_id = next(self.c._app_seq)
@@ -83,6 +85,9 @@ class MemAccessKeys(base.AccessKeys):
     def insert(self, k: AccessKey) -> Optional[str]:
         with self.c.lock:
             key = k.key or self.generate_key()
+            if key in self.c.access_keys:
+                raise base.StorageWriteError(
+                    f"Access key {key!r} already exists")
             self.c.access_keys[key] = AccessKey(key, k.appid, tuple(k.events))
             return key
 
@@ -110,6 +115,9 @@ class MemChannels(base.Channels):
 
     def insert(self, channel: Channel) -> Optional[int]:
         with self.c.lock:
+            if channel.id and channel.id in self.c.channels:
+                raise base.StorageWriteError(
+                    f"Channel id {channel.id} already exists")
             cid = channel.id or next(self.c._channel_seq)
             while channel.id == 0 and cid in self.c.channels:
                 cid = next(self.c._channel_seq)

@@ -102,7 +102,7 @@ class SQLiteApps(base.Apps):
                 return cur.lastrowid
         except sqlite3.IntegrityError as ex:
             raise base.StorageWriteError(
-                f"App name {app.name!r} already exists") from ex
+                f"App id or name already exists ({ex})") from ex
 
     def get(self, app_id: int) -> Optional[App]:
         with self.c.lock:
@@ -141,10 +141,14 @@ class SQLiteAccessKeys(base.AccessKeys):
 
     def insert(self, k: AccessKey) -> Optional[str]:
         key = k.key or self.generate_key()
-        with self.c.lock, self.c.conn:
-            self.c.conn.execute(
-                "INSERT INTO access_keys (accesskey, appid, events) VALUES (?,?,?)",
-                (key, k.appid, json.dumps(list(k.events))))
+        try:
+            with self.c.lock, self.c.conn:
+                self.c.conn.execute(
+                    "INSERT INTO access_keys (accesskey, appid, events) VALUES (?,?,?)",
+                    (key, k.appid, json.dumps(list(k.events))))
+        except sqlite3.IntegrityError as ex:
+            raise base.StorageWriteError(
+                f"Access key {key!r} already exists") from ex
         return key
 
     def get(self, key: str) -> Optional[AccessKey]:
@@ -184,16 +188,20 @@ class SQLiteChannels(base.Channels):
         self.c = client
 
     def insert(self, channel: Channel) -> Optional[int]:
-        with self.c.lock, self.c.conn:
-            if channel.id:
-                self.c.conn.execute(
-                    "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
-                    (channel.id, channel.name, channel.appid))
-                return channel.id
-            cur = self.c.conn.execute(
-                "INSERT INTO channels (name, appid) VALUES (?,?)",
-                (channel.name, channel.appid))
-            return cur.lastrowid
+        try:
+            with self.c.lock, self.c.conn:
+                if channel.id:
+                    self.c.conn.execute(
+                        "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
+                        (channel.id, channel.name, channel.appid))
+                    return channel.id
+                cur = self.c.conn.execute(
+                    "INSERT INTO channels (name, appid) VALUES (?,?)",
+                    (channel.name, channel.appid))
+                return cur.lastrowid
+        except sqlite3.IntegrityError as ex:
+            raise base.StorageWriteError(
+                f"Channel id {channel.id} already exists") from ex
 
     def get(self, channel_id: int) -> Optional[Channel]:
         with self.c.lock:

@@ -1,0 +1,232 @@
+"""Minimal threaded HTTP framework used by every host-side server.
+
+The reference builds its REST planes on spray/akka actors
+(`data/.../api/EventServer.scala`, `core/.../workflow/CreateServer.scala`,
+`tools/.../dashboard/Dashboard.scala`). Here one stdlib-based router serves
+all of them: the servers are host-side control planes — the hot compute
+path lives on device — so a threaded stdlib server is sufficient and keeps
+the framework dependency-free.
+
+Features: method+path-pattern routing with `<name>` captures, JSON
+request/response helpers, query params, per-request context, graceful
+shutdown, optional TLS via an ssl context.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import ssl as ssl_module
+import threading
+import traceback
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Mapping[str, str]
+    headers: Mapping[str, str]
+    body: bytes
+    params: Mapping[str, str] = field(default_factory=dict)  # path captures
+    client: str = ""
+
+    def json(self) -> Any:
+        if not self.body:
+            raise ValueError("Empty request body")
+        try:
+            return json.loads(self.body.decode("utf-8"))
+        except json.JSONDecodeError as e:
+            raise ValueError(f"Invalid JSON: {e}") from e
+
+    def query_get(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self.query.get(name, default)
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None              # JSON-serializable, or bytes, or str
+    content_type: str = "application/json"
+    headers: Mapping[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def json(obj: Any, status: int = 200, **headers) -> "Response":
+        return Response(status=status, body=obj, headers=headers)
+
+    @staticmethod
+    def text(s: str, status: int = 200, content_type: str = "text/plain") -> "Response":
+        return Response(status=status, body=s, content_type=content_type)
+
+    @staticmethod
+    def html(s: str, status: int = 200) -> "Response":
+        return Response(status=status, body=s, content_type="text/html")
+
+
+Handler = Callable[[Request], Response]
+
+
+class HTTPError(Exception):
+    """Raise from a handler to produce a JSON error response."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _compile(pattern: str) -> re.Pattern:
+    """`<name>` captures one segment; `<name:path>` captures across slashes."""
+    parts = []
+    for piece in re.split(r"(<[a-zA-Z_]+(?::path)?>)", pattern):
+        if piece.startswith("<") and piece.endswith(">"):
+            inner = piece[1:-1]
+            if inner.endswith(":path"):
+                parts.append(f"(?P<{inner[:-5]}>.+)")
+            else:
+                parts.append(f"(?P<{inner}>[^/]+)")
+        else:
+            parts.append(re.escape(piece))
+    return re.compile("^" + "".join(parts) + "$")
+
+
+class Router:
+    def __init__(self):
+        self.routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def route(self, method: str, pattern: str):
+        def deco(fn: Handler) -> Handler:
+            self.routes.append((method.upper(), _compile(pattern), fn))
+            return fn
+        return deco
+
+    def get(self, pattern: str):
+        return self.route("GET", pattern)
+
+    def post(self, pattern: str):
+        return self.route("POST", pattern)
+
+    def delete(self, pattern: str):
+        return self.route("DELETE", pattern)
+
+    def dispatch(self, req: Request) -> Response:
+        path_matched = False
+        for method, regex, fn in self.routes:
+            m = regex.match(req.path)
+            if m:
+                path_matched = True
+                if method == req.method:
+                    req.params = m.groupdict()
+                    try:
+                        return fn(req)
+                    except HTTPError as e:
+                        return Response.json({"message": e.message}, e.status)
+                    except ValueError as e:
+                        return Response.json({"message": str(e)}, 400)
+                    except Exception as e:  # pragma: no cover - defensive
+                        traceback.print_exc()
+                        return Response.json({"message": f"{e}"}, 500)
+        if path_matched:
+            return Response.json({"message": "Method Not Allowed"}, 405)
+        return Response.json({"message": "Not Found"}, 404)
+
+
+class HTTPServerBase:
+    """A threaded HTTP server wrapping a Router; start()/shutdown() API.
+
+    Subclasses populate `self.router`. Parity note: plays the role of
+    spray-can's `IO(Http) ! Http.Bind` + actor routing in the reference
+    servers.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 ssl_context: Optional[ssl_module.SSLContext] = None):
+        self.host = host
+        self.port = port
+        self.router = Router()
+        self._ssl_context = ssl_context
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, background: bool = True) -> int:
+        router = self.router
+        server_ref = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self):
+                parsed = urlparse(self.path)
+                raw_q = parse_qs(parsed.query, keep_blank_values=True)
+                query = {k: v[0] for k, v in raw_q.items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(
+                    method=self.command, path=unquote(parsed.path), query=query,
+                    headers={k: v for k, v in self.headers.items()},
+                    body=body, client=self.client_address[0])
+                resp = router.dispatch(req)
+                payload = resp.body
+                if isinstance(payload, bytes):
+                    data = payload
+                elif isinstance(payload, str):
+                    data = payload.encode("utf-8")
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data)
+
+            do_GET = do_POST = do_DELETE = do_PUT = do_HEAD = _respond
+
+            def log_message(self, fmt, *args):  # quiet by default
+                server_ref.log_request_line(fmt % args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        if self._ssl_context is not None:
+            self._httpd.socket = self._ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True)
+        self.port = self._httpd.server_address[1]
+        if background:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def log_request_line(self, line: str) -> None:
+        pass
+
+
+def parse_basic_auth_user(headers: Mapping[str, str]) -> Optional[str]:
+    """Extract the username of a Basic Authorization header (the reference
+    accepts the access key as the Basic username, EventServer.scala:114-126)."""
+    import base64
+    auth = headers.get("Authorization") or headers.get("authorization")
+    if not auth or not auth.startswith("Basic "):
+        return None
+    try:
+        decoded = base64.b64decode(auth[len("Basic "):]).decode("utf-8")
+    except Exception:
+        return None
+    return decoded.split(":")[0].strip() or None

@@ -108,6 +108,15 @@ class TestAggregation:
         assert out["u1"].fields == DataMap({"a": 1})
         assert out["u1"].last_updated == at(0)
 
+    def test_same_timestamp_set_right_operand_wins(self):
+        # reference SetProp.++ keeps `that` on equal timestamps, so the later
+        # fold element (== later event in a time-sorted replay) wins
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 5),
+            set_("u1", {"a": 2}, 5),
+        ])
+        assert out["u1"].fields == DataMap({"a": 2})
+
     def test_multiple_entities(self):
         out = aggregate_properties([
             set_("u1", {"a": 1}, 0), set_("u2", {"a": 2}, 0)])

@@ -115,6 +115,18 @@ class TestEventJson:
         with pytest.raises(ValueError):
             Event.from_api_json({"entityType": "user", "entityId": "u1"})
 
+    def test_field_type_checks(self):
+        base = {"event": "view", "entityType": "user", "entityId": "u1"}
+        with pytest.raises(ValueError):
+            Event.from_api_json(dict(base, tags="important"))
+        with pytest.raises(ValueError):
+            Event.from_api_json(dict(base, tags=[1, 2]))
+        with pytest.raises(ValueError):
+            Event.from_api_json(dict(base, targetEntityType=123,
+                                     targetEntityId="x"))
+        with pytest.raises(ValueError):
+            Event.from_api_json(dict(base, prId=5))
+
     def test_time_parsing(self):
         t = parse_time("2020-05-01T12:30:00.250Z")
         assert t.tzinfo is not None

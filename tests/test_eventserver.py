@@ -1,0 +1,264 @@
+"""Event server route tests over a live HTTP server.
+
+Mirrors reference `data/src/test/scala/.../EventServiceSpec.scala` (route
+behavior with mocked storage), `tests/pio_tests/scenarios/eventserver_test.py`
+(batch semantics incl. partially malformed payloads), and webhook connector
+specs.
+"""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.eventserver import EventServer, EventServerConfig
+from predictionio_tpu.data.plugins import EventServerPlugin, INPUT_BLOCKER
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+
+
+class RejectBlocked(Exception):
+    pass
+
+
+class BlockerPlugin(EventServerPlugin):
+    plugin_name = "testblocker"
+    plugin_description = "blocks events with property blocked=true"
+    plugin_type = INPUT_BLOCKER
+
+    def process(self, event_info, context):
+        if event_info.event.properties.get_or_else("blocked", False):
+            raise ValueError("event blocked by testblocker")
+
+
+@pytest.fixture()
+def server(mem_registry):
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp"))
+    keys = mem_registry.get_meta_data_access_keys()
+    keys.insert(AccessKey("KEY", app_id, ()))
+    keys.insert(AccessKey("LIMITED", app_id, ("view",)))
+    mem_registry.get_meta_data_channels().insert(Channel(0, "mobile", app_id))
+    mem_registry.get_events().init(app_id)
+    srv = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True,
+                          plugins=[BlockerPlugin()]),
+        mem_registry)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def call(server, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    data = json.dumps(body).encode() if isinstance(body, (dict, list)) else body
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    if data is not None and "Content-Type" not in (headers or {}):
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+EV = {"event": "view", "entityType": "user", "entityId": "u1"}
+
+
+class TestAuth:
+    def test_alive(self, server):
+        assert call(server, "GET", "/") == (200, {"status": "alive"})
+
+    def test_missing_key(self, server):
+        status, body = call(server, "POST", "/events.json", EV)
+        assert (status, body["message"]) == (401, "Missing accessKey.")
+
+    def test_invalid_key(self, server):
+        status, body = call(server, "POST", "/events.json?accessKey=WRONG", EV)
+        assert (status, body["message"]) == (401, "Invalid accessKey.")
+
+    def test_basic_auth_header(self, server):
+        creds = base64.b64encode(b"KEY:").decode()
+        status, body = call(server, "POST", "/events.json", EV,
+                            {"Authorization": f"Basic {creds}"})
+        assert status == 201 and "eventId" in body
+
+    def test_invalid_channel(self, server):
+        status, body = call(
+            server, "POST", "/events.json?accessKey=KEY&channel=nope", EV)
+        assert (status, body["message"]) == (401, "Invalid channel 'nope'.")
+
+    def test_channel_isolation(self, server):
+        call(server, "POST", "/events.json?accessKey=KEY&channel=mobile", EV)
+        status, body = call(server, "GET", "/events.json?accessKey=KEY")
+        assert status == 404
+        status, body = call(
+            server, "GET", "/events.json?accessKey=KEY&channel=mobile")
+        assert status == 200 and len(body) == 1
+
+
+class TestEventsCRUD:
+    def test_post_get_delete(self, server):
+        status, body = call(server, "POST", "/events.json?accessKey=KEY", EV)
+        assert status == 201
+        eid = body["eventId"]
+        status, body = call(server, "GET",
+                            f"/events/{eid}.json?accessKey=KEY")
+        assert status == 200 and body["entityId"] == "u1"
+        status, body = call(server, "DELETE",
+                            f"/events/{eid}.json?accessKey=KEY")
+        assert (status, body["message"]) == (200, "Found")
+        status, body = call(server, "DELETE",
+                            f"/events/{eid}.json?accessKey=KEY")
+        assert (status, body["message"]) == (404, "Not Found")
+
+    def test_invalid_event_rejected(self, server):
+        bad = {"event": "$unset", "entityType": "user", "entityId": "u1"}
+        status, body = call(server, "POST", "/events.json?accessKey=KEY", bad)
+        assert status == 400
+
+    def test_allowed_events_enforced(self, server):
+        status, _ = call(server, "POST", "/events.json?accessKey=LIMITED", EV)
+        assert status == 201
+        buy = dict(EV, event="buy")
+        status, body = call(server, "POST", "/events.json?accessKey=LIMITED", buy)
+        assert (status, body["message"]) == (403, "buy events are not allowed")
+
+    def test_query_filters_and_default_limit(self, server):
+        for i in range(25):
+            e = {"event": "view", "entityType": "user", "entityId": f"u{i}",
+                 "eventTime": f"2020-01-01T00:{i:02d}:00.000Z"}
+            call(server, "POST", "/events.json?accessKey=KEY", e)
+        status, body = call(server, "GET", "/events.json?accessKey=KEY")
+        assert status == 200 and len(body) == 20  # default limit
+        status, body = call(server, "GET",
+                            "/events.json?accessKey=KEY&limit=-1")
+        assert len(body) == 25
+        status, body = call(
+            server, "GET",
+            "/events.json?accessKey=KEY&startTime=2020-01-01T00:10:00.000Z"
+            "&untilTime=2020-01-01T00:12:00.000Z&limit=-1")
+        assert [e["entityId"] for e in body] == ["u10", "u11"]
+
+    def test_reversed_requires_entity(self, server):
+        status, body = call(server, "GET",
+                            "/events.json?accessKey=KEY&reversed=true")
+        assert status == 400
+
+    def test_blocker_plugin_vetoes(self, server):
+        blocked = dict(EV, properties={"blocked": True})
+        status, body = call(server, "POST", "/events.json?accessKey=KEY",
+                            blocked)
+        assert status == 400 and "blocked by testblocker" in body["message"]
+
+
+class TestBatch:
+    def test_batch_mixed_statuses(self, server):
+        batch = [
+            EV,
+            {"event": "buy", "entityType": "user"},        # malformed
+            dict(EV, event="$bad"),                        # invalid name
+        ]
+        status, body = call(server, "POST",
+                            "/batch/events.json?accessKey=KEY", batch)
+        assert status == 200
+        assert [r["status"] for r in body] == [201, 400, 400]
+        assert "eventId" in body[0]
+
+    def test_batch_limit_50(self, server):
+        batch = [EV] * 51
+        status, body = call(server, "POST",
+                            "/batch/events.json?accessKey=KEY", batch)
+        assert status == 400 and "less than or equal to 50" in body["message"]
+
+    def test_batch_allowed_events(self, server):
+        batch = [EV, dict(EV, event="buy")]
+        status, body = call(server, "POST",
+                            "/batch/events.json?accessKey=LIMITED", batch)
+        assert [r["status"] for r in body] == [201, 403]
+
+
+class TestStatsAndPlugins:
+    def test_stats(self, server):
+        call(server, "POST", "/events.json?accessKey=KEY", EV)
+        status, body = call(server, "GET", "/stats.json?accessKey=KEY")
+        assert status == 200
+        assert body["currentHour"][0]["event"] == "view"
+        assert body["currentHour"][0]["count"] == 1
+
+    def test_encoded_event_id_roundtrip(self, server):
+        from urllib.parse import quote
+        e = dict(EV, eventId="id with space")
+        status, body = call(server, "POST", "/events.json?accessKey=KEY", e)
+        assert status == 201
+        status, body = call(
+            server, "GET",
+            f"/events/{quote('id with space')}.json?accessKey=KEY")
+        assert status == 200 and body["eventId"] == "id with space"
+
+    def test_plugin_rest_with_args(self, server):
+        status, body = call(
+            server, "GET",
+            "/plugins/inputblocker/testblocker/status/x?accessKey=KEY")
+        assert status == 200
+
+    def test_plugins_json(self, server):
+        status, body = call(server, "GET", "/plugins.json")
+        assert status == 200
+        assert "testblocker" in body["plugins"]["inputblockers"]
+
+
+class TestWebhooks:
+    def test_segmentio_json(self, server):
+        payload = {
+            "type": "track", "user_id": "sio-user", "event": "signup",
+            "timestamp": "2020-02-02T03:04:05.000Z",
+            "properties": {"plan": "pro"},
+        }
+        status, body = call(server, "POST",
+                            "/webhooks/segmentio.json?accessKey=KEY", payload)
+        assert status == 201
+        status, body = call(
+            server, "GET",
+            "/events.json?accessKey=KEY&entityType=user&entityId=sio-user")
+        assert status == 200
+        assert body[0]["event"] == "track"
+        assert body[0]["properties"]["properties"]["plan"] == "pro"
+
+    def test_segmentio_bad_payload(self, server):
+        status, body = call(server, "POST",
+                            "/webhooks/segmentio.json?accessKey=KEY",
+                            {"type": "track"})
+        assert status == 400
+
+    def test_unknown_webhook(self, server):
+        status, body = call(server, "POST",
+                            "/webhooks/nonexistent.json?accessKey=KEY", {})
+        assert status == 404 and "not supported" in body["message"]
+        status, body = call(server, "GET",
+                            "/webhooks/segmentio.json?accessKey=KEY")
+        assert (status, body["message"]) == (200, "Ok")
+
+    def test_mailchimp_form(self, server):
+        from urllib.parse import urlencode
+        fields = {
+            "type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+            "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+            "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@mailchimp.com",
+            "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+            "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+        }
+        status, body = call(
+            server, "POST", "/webhooks/mailchimp.form?accessKey=KEY",
+            urlencode(fields).encode(),
+            {"Content-Type": "application/x-www-form-urlencoded"})
+        assert status == 201
+        status, body = call(
+            server, "GET",
+            "/events.json?accessKey=KEY&entityType=user&entityId=8a25ff1d98")
+        assert body[0]["event"] == "subscribe"
+        assert body[0]["targetEntityId"] == "a6b5da1054"
+        assert body[0]["eventTime"].startswith("2009-03-26T21:35:57")
