@@ -286,7 +286,9 @@ class Event:
         for name in ("targetEntityType", "targetEntityId", "prId", "eventId"):
             if obj.get(name) is not None and not isinstance(obj[name], str):
                 raise ValueError(f"field {name} must be a string")
-        tags = obj.get("tags") or ()
+        tags = obj.get("tags")
+        if tags is None:
+            tags = ()
         if not isinstance(tags, (list, tuple)) or not all(
                 isinstance(t, str) for t in tags):
             raise ValueError("field tags must be an array of strings")

@@ -99,7 +99,13 @@ class EventServer(HTTPServerBase):
     def _ingest(self, event: Event, auth: AuthData) -> str:
         info = EventInfo(auth.app_id, auth.channel_id, event)
         self.plugin_context.run_blockers(info)
-        event_id = self.event_client.insert(event, auth.app_id, auth.channel_id)
+        try:
+            event_id = self.event_client.insert(
+                event, auth.app_id, auth.channel_id)
+        except StorageWriteError as e:
+            # a rejected write (e.g. duplicate explicit eventId) is a client
+            # error on every ingest surface: single, batch, and webhooks
+            raise HTTPError(400, str(e))
         self.plugin_context.notify_sniffers(info)
         if self.config.stats:
             self.stats.bookkeeping(auth.app_id, 201, event)
@@ -138,10 +144,7 @@ class EventServer(HTTPServerBase):
             if auth.events and event.event not in auth.events:
                 return Response.json(
                     {"message": f"{event.event} events are not allowed"}, 403)
-            try:
-                event_id = self._ingest(event, auth)
-            except StorageWriteError as e:
-                raise HTTPError(400, str(e))
+            event_id = self._ingest(event, auth)
             return Response.json({"eventId": event_id}, 201)
 
         @r.get("/events.json")
@@ -214,6 +217,8 @@ class EventServer(HTTPServerBase):
                 try:
                     event_id = self._ingest(event, auth)
                     results.append({"status": 201, "eventId": event_id})
+                except HTTPError as e:
+                    results.append({"status": e.status, "message": e.message})
                 except Exception as e:
                     results.append({"status": 500, "message": str(e)})
             return Response.json(results)

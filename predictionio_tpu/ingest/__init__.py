@@ -1,0 +1,22 @@
+"""Ingestion: event streams -> dense, sharded jax.Array columns.
+
+This package is the framework's replacement for the reference's
+`PEvents.find(...): RDD[Event]` + per-template RDD pipelines
+(`data/.../storage/PEvents.scala:80-103`). Instead of a lazy distributed
+collection of JVM objects, the data currency is a set of dense numpy/JAX
+columns with static, bucket-padded shapes, plus `BiMap`s bridging string
+entity IDs to dense indexes (reference `data/.../storage/BiMap.scala`).
+
+Typical flow (the analog of a template's DataSource):
+    events  = store.find(app_id, event_names=["rate", "buy"])
+    ratings = RatingColumns.from_events(events, rating_of=...)
+    dev     = ratings.shard(mesh)   # padded + device_put over the mesh
+"""
+
+from predictionio_tpu.ingest.bimap import BiMap  # noqa: F401
+from predictionio_tpu.ingest.arrays import (  # noqa: F401
+    RatingColumns,
+    PairColumns,
+    LabeledPoints,
+    labeled_points_from_properties,
+)

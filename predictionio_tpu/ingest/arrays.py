@@ -1,0 +1,224 @@
+"""Dense column sets built from event streams.
+
+The RDD replacement (SURVEY.md §7 phase 2): filtered event streams become
+numpy column structs with BiMap-indexed entities, which `shard()` pads to
+static bucket sizes and lays out over a device mesh. Downstream algorithms
+(`predictionio_tpu.ops`) consume only these dense columns — no Python
+objects cross into jit.
+
+Reference analogs:
+  - RatingColumns   <- the per-template `RDD[Rating]` built in DataSource
+    (`examples/scala-parallel-recommendation/.../DataSource.scala:43-72`)
+  - PairColumns     <- view/like event pair RDDs for cooccurrence
+    (`examples/.../CooccurrenceAlgorithm.scala:47-110`)
+  - LabeledPoints   <- `RDD[LabeledPoint]` from aggregated properties
+    (`examples/scala-parallel-classification/.../DataSource.scala`)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.event import Event, to_millis
+from predictionio_tpu.ingest.bimap import BiMap
+from predictionio_tpu.parallel import shard_put
+
+
+@dataclass
+class ShardedColumns:
+    """Columns on device: dict name -> sharded jax.Array, plus the true
+    (pre-padding) row count and a validity mask."""
+    arrays: Dict[str, object]
+    n_valid: int
+
+    def __getitem__(self, k: str):
+        return self.arrays[k]
+
+
+class _ColumnSet:
+    """Common pad-and-shard behavior for event-derived column structs."""
+
+    _FILL: Mapping[str, object] = {}
+
+    def _columns(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @property
+    def n(self) -> int:
+        cols = self._columns()
+        return next(iter(cols.values())).shape[0] if cols else 0
+
+    def shard(self, mesh, axis: str = "data") -> ShardedColumns:
+        """Pad every column to a common multiple of the mesh axis and
+        device_put with dim-0 sharding. Padded tail rows carry neutral fill
+        values (index 0, weight 0) so reductions can ignore them via the
+        implicit `weight/rating == 0` mask or the returned n_valid."""
+        cols = self._columns()
+        out: Dict[str, object] = {}
+        n = self.n
+        for name, a in cols.items():
+            arr, _ = shard_put(a, mesh, axis, fill=self._FILL.get(name, 0))
+            out[name] = arr
+        return ShardedColumns(out, n)
+
+
+@dataclass
+class RatingColumns(_ColumnSet):
+    """COO rating triples (user, item, rating, t_millis) with BiMaps."""
+    user_ix: np.ndarray      # int32 [n]
+    item_ix: np.ndarray      # int32 [n]
+    rating: np.ndarray       # float32 [n]
+    t_millis: np.ndarray     # int64 [n]
+    users: BiMap
+    items: BiMap
+
+    def _columns(self) -> Dict[str, np.ndarray]:
+        return {"user_ix": self.user_ix, "item_ix": self.item_ix,
+                "rating": self.rating, "t_millis": self.t_millis}
+
+    @staticmethod
+    def from_events(events: Iterable[Event], *,
+                    rating_of: Optional[Callable[[Event], Optional[float]]] = None,
+                    users: Optional[BiMap] = None,
+                    items: Optional[BiMap] = None,
+                    dedup_last_wins: bool = False) -> "RatingColumns":
+        """Build rating triples from events.
+
+        `rating_of` maps an event to a rating value (None = skip); the
+        default reads the `rating` property of rate events and scores
+        implicit events (buy/view/like) as 1.0. Templates override it for
+        custom scales — e.g. the quickstart maps buy->4.0
+        (`examples/.../train-with-view-event/.../DataSource.scala`).
+        `dedup_last_wins` keeps only the latest-by-eventTime rating per
+        (user, item) — the semantics ALS templates get from `.reduceByKey`
+        on keyed ratings.
+        """
+        rating_of = rating_of or default_rating_of
+        fixed_u, fixed_i = users is not None, items is not None
+        rows: list = []
+        for e in events:
+            r = rating_of(e)
+            if r is None or e.entity_id is None or e.target_entity_id is None:
+                continue
+            rows.append((e.entity_id, e.target_entity_id, float(r),
+                         to_millis(e.event_time)))
+        u_map = users if fixed_u else BiMap.from_keys(r[0] for r in rows)
+        i_map = items if fixed_i else BiMap.from_keys(r[1] for r in rows)
+        kept: list = []
+        for uid, iid, r, t in rows:
+            u, i = u_map.get(uid), i_map.get(iid)
+            if u is None or i is None:   # unseen under a fixed BiMap: drop
+                continue
+            kept.append((u, i, r, t))
+        if dedup_last_wins:
+            by_key: Dict[Tuple[int, int], Tuple[int, int, float, int]] = {}
+            for row in kept:
+                k = (row[0], row[1])
+                if k not in by_key or row[3] >= by_key[k][3]:
+                    by_key[k] = row
+            kept = list(by_key.values())
+        if kept:
+            u_ix, i_ix, rs, ts = (np.array(x) for x in zip(*kept))
+        else:
+            u_ix = i_ix = np.zeros(0, np.int32)
+            rs, ts = np.zeros(0, np.float32), np.zeros(0, np.int64)
+        return RatingColumns(u_ix.astype(np.int32), i_ix.astype(np.int32),
+                             rs.astype(np.float32), ts.astype(np.int64),
+                             u_map, i_map)
+
+
+def default_rating_of(e: Event) -> Optional[float]:
+    """'rate' events use their rating property; 'buy'/'view'/'like' style
+    implicit events count as 1.0 unless a rating property is present."""
+    if e.event == "rate" or "rating" in e.properties:
+        v = e.properties.get_opt("rating")
+        return float(v) if v is not None else None
+    return 1.0
+
+
+@dataclass
+class PairColumns(_ColumnSet):
+    """(entity, target) index pairs for cooccurrence-style algorithms."""
+    left_ix: np.ndarray    # int32 [n]
+    right_ix: np.ndarray   # int32 [n]
+    weight: np.ndarray     # float32 [n]; padded rows have weight 0
+    left: BiMap
+    right: BiMap
+
+    _FILL = {"weight": 0.0}
+
+    def _columns(self) -> Dict[str, np.ndarray]:
+        return {"left_ix": self.left_ix, "right_ix": self.right_ix,
+                "weight": self.weight}
+
+    @staticmethod
+    def from_events(events: Iterable[Event], *,
+                    weight_of: Optional[Callable[[Event], Optional[float]]] = None,
+                    left: Optional[BiMap] = None,
+                    right: Optional[BiMap] = None) -> "PairColumns":
+        weight_of = weight_of or (lambda e: 1.0)
+        rows: list = []
+        for e in events:
+            w = weight_of(e)
+            if w is None or e.entity_id is None or e.target_entity_id is None:
+                continue
+            rows.append((e.entity_id, e.target_entity_id, float(w)))
+        l_map = left if left is not None else BiMap.from_keys(r[0] for r in rows)
+        r_map = right if right is not None else BiMap.from_keys(r[1] for r in rows)
+        kept = [(l_map.get(a), r_map.get(b), w) for a, b, w in rows
+                if l_map.get(a) is not None and r_map.get(b) is not None]
+        if kept:
+            li, ri, ws = (np.array(x) for x in zip(*kept))
+        else:
+            li = ri = np.zeros(0, np.int32)
+            ws = np.zeros(0, np.float32)
+        return PairColumns(li.astype(np.int32), ri.astype(np.int32),
+                           ws.astype(np.float32), l_map, r_map)
+
+
+@dataclass
+class LabeledPoints(_ColumnSet):
+    """Dense feature matrix + labels (the RDD[LabeledPoint] analog)."""
+    features: np.ndarray   # float32 [n, d]
+    label: np.ndarray      # float32 [n]
+    entities: BiMap        # row -> entityId
+
+    _FILL = {"label": -1.0}   # padded rows get an impossible label
+
+    def _columns(self) -> Dict[str, np.ndarray]:
+        return {"features": self.features, "label": self.label}
+
+
+def labeled_points_from_properties(
+        props: Mapping[str, object], *,
+        feature_attrs: Sequence[str],
+        label_attr: str,
+        label_map: Optional[Mapping[str, float]] = None) -> LabeledPoints:
+    """Aggregated entity properties -> (features, label) arrays.
+
+    `props` is the output of `EventStore.aggregate_properties` (entityId ->
+    PropertyMap). Entities missing any required attr are skipped, matching
+    the classification DataSource's error-and-drop behavior
+    (`examples/scala-parallel-classification/.../DataSource.scala`).
+    `label_map` converts categorical string labels to floats.
+    """
+    ids: list = []
+    feats: list = []
+    labels: list = []
+    for eid, pm in props.items():
+        try:
+            row = [float(pm.get(a)) for a in feature_attrs]
+            raw = pm.get(label_attr)
+            y = float(label_map[raw]) if label_map is not None else float(raw)
+        except (KeyError, TypeError, ValueError):
+            continue
+        ids.append(eid)
+        feats.append(row)
+        labels.append(y)
+    f = (np.array(feats, np.float32) if feats
+         else np.zeros((0, len(feature_attrs)), np.float32))
+    return LabeledPoints(f, np.array(labels, np.float32),
+                         BiMap.from_keys(ids))

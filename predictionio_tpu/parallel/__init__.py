@@ -1,0 +1,28 @@
+"""Mesh construction, named shardings, and collective helpers.
+
+This layer replaces the reference's distributed execution substrate (Spark
+driver↔executor RPC + shuffle; see SURVEY.md §2.8). Where the reference
+scales by partitioning RDDs over executor JVMs, this framework scales by
+laying out dense `jax.Array`s over a `jax.sharding.Mesh` and letting XLA
+emit ICI collectives from sharding annotations.
+
+Canonical mesh axes used throughout the framework:
+  "data"  — batch/data parallelism (the analog of RDD partitioning)
+  "model" — tensor/model parallelism (factor-matrix sharding for ALS,
+            embedding-table sharding for the two-tower template)
+
+Multi-host: `initialize_distributed` wires `jax.distributed` the way the
+reference forwarded its env across the spark-submit boundary
+(`tools/.../Runner.scala:185-307`); on a single host it is a no-op.
+"""
+
+from predictionio_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    shard_put,
+    pad_to_multiple,
+    pad_rows,
+    initialize_distributed,
+)

@@ -120,7 +120,11 @@ class Router:
             if m:
                 path_matched = True
                 if method == req.method:
-                    req.params = m.groupdict()
+                    # captures are matched against the raw (still-encoded)
+                    # path, then decoded individually — decoding first would
+                    # let %2F alter routing and make such ids unreachable
+                    req.params = {k: unquote(v)
+                                  for k, v in m.groupdict().items()}
                     try:
                         return fn(req)
                     except HTTPError as e:
@@ -167,7 +171,7 @@ class HTTPServerBase:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(
-                    method=self.command, path=unquote(parsed.path), query=query,
+                    method=self.command, path=parsed.path, query=query,
                     headers={k: v for k, v in self.headers.items()},
                     body=body, client=self.client_address[0])
                 resp = router.dispatch(req)

@@ -8,11 +8,18 @@ first initialization, hence the env mutation at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The env var alone is not enough where a site customization pre-selects a
+# platform; the config update is authoritative as long as no backend has
+# been initialized yet.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -198,6 +198,38 @@ class TestStatsAndPlugins:
             f"/events/{quote('id with space')}.json?accessKey=KEY")
         assert status == 200 and body["eventId"] == "id with space"
 
+    def test_slash_in_event_id_roundtrip(self, server):
+        # %2F must not be decoded before route matching, or the id becomes
+        # unreachable (matches per-segment decode semantics of spray)
+        e = dict(EV, eventId="a/b")
+        status, _ = call(server, "POST", "/events.json?accessKey=KEY", e)
+        assert status == 201
+        status, body = call(
+            server, "GET", "/events/a%2Fb.json?accessKey=KEY")
+        assert status == 200 and body["eventId"] == "a/b"
+        status, _ = call(
+            server, "DELETE", "/events/a%2Fb.json?accessKey=KEY")
+        assert status == 200
+
+    def test_duplicate_event_id_is_400_everywhere(self, server):
+        e = dict(EV, eventId="dup1")
+        assert call(server, "POST", "/events.json?accessKey=KEY", e)[0] == 201
+        # single insert: 400
+        assert call(server, "POST", "/events.json?accessKey=KEY", e)[0] == 400
+        # batch insert: per-item 400, not 500
+        status, body = call(
+            server, "POST", "/batch/events.json?accessKey=KEY", [e])
+        assert status == 200 and body[0]["status"] == 400
+
+    def test_falsy_tags_rejected(self, server):
+        for bad in (False, 0, "", "x", [1]):
+            e = dict(EV, tags=bad)
+            status, body = call(server, "POST", "/events.json?accessKey=KEY", e)
+            assert status == 400, f"tags={bad!r} accepted"
+        status, _ = call(server, "POST", "/events.json?accessKey=KEY",
+                         dict(EV, tags=["a", "b"]))
+        assert status == 201
+
     def test_plugin_rest_with_args(self, server):
         status, body = call(
             server, "GET",
