@@ -1,0 +1,37 @@
+"""DASE core: base abstractions, Engine, workflow, persistence.
+
+The analog of the reference's `core/` module (SURVEY.md §2.1): typed DASE
+component contracts (`base.py` ≙ `core/.../core/Base*.scala`), the concrete
+`Engine` with named component maps (`engine.py` ≙
+`core/.../controller/Engine.scala`), typed JSON params extraction
+(`params.py` ≙ `core/.../workflow/JsonExtractor.scala`), train/eval
+orchestration (`workflow.py` ≙ `core/.../workflow/CoreWorkflow.scala`), and
+model persistence (`persistence.py` ≙ Kryo + `PersistentModel`).
+
+The structural difference from the reference: where every Base* method took
+a `SparkContext`, components here receive a `RuntimeContext` carrying the
+device mesh, the storage registry, and workflow params — the single-
+controller JAX replacement for the Spark driver.
+"""
+
+from predictionio_tpu.core.params import (  # noqa: F401
+    Params, EmptyParams, EngineParams, extract_params, params_to_json,
+)
+from predictionio_tpu.core.runtime import (  # noqa: F401
+    RuntimeContext, WorkflowParams,
+)
+from predictionio_tpu.core.base import (  # noqa: F401
+    DataSource, Preparator, IdentityPreparator, Algorithm, Serving,
+    FirstServing, Evaluator, TrainingInterrupted, StopAfterReadInterruption,
+    StopAfterPrepareInterruption,
+)
+from predictionio_tpu.core.persistence import (  # noqa: F401
+    PersistentModel, PersistentModelManifest, serialize_models,
+    deserialize_models,
+)
+from predictionio_tpu.core.engine import (  # noqa: F401
+    Engine, EngineFactory, SimpleEngine,
+)
+from predictionio_tpu.core.workflow import (  # noqa: F401
+    CoreWorkflow, register_engine, resolve_engine,
+)

@@ -1,0 +1,177 @@
+"""Engine: concrete DASE pipeline with named component maps.
+
+Parity target: `core/.../controller/Engine.scala` (832 LoC) — component
+class maps, `train` (sequential per-algorithm loop, Engine.scala:692),
+`eval` (folds × algorithms cartesian, Engine.scala:730-820), JSON variant ->
+EngineParams extraction (`jValueToEngineParams:357-420`), and the
+deploy-time model preparation split out into persistence.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+
+from predictionio_tpu.core.base import (
+    Algorithm, DataSource, Preparator, Serving,
+    StopAfterPrepareInterruption, StopAfterReadInterruption, sanity_check,
+)
+from predictionio_tpu.core.params import (
+    EngineParams, ParamsError, Params, extract_params,
+)
+from predictionio_tpu.core.runtime import RuntimeContext
+
+
+class Engine:
+    """An engine = named maps of DASE component classes
+    (Engine.scala:101-155). Single-class convenience: pass the class itself
+    instead of a one-entry map and it is registered under ''."""
+
+    def __init__(self,
+                 data_source: "Mapping[str, Type[DataSource]] | Type[DataSource]",
+                 preparator: "Mapping[str, Type[Preparator]] | Type[Preparator]",
+                 algorithms: "Mapping[str, Type[Algorithm]] | Type[Algorithm]",
+                 serving: "Mapping[str, Type[Serving]] | Type[Serving]"):
+        self.data_source_classes = self._as_map(data_source)
+        self.preparator_classes = self._as_map(preparator)
+        self.algorithm_classes = self._as_map(algorithms)
+        self.serving_classes = self._as_map(serving)
+
+    @staticmethod
+    def _as_map(x) -> Dict[str, type]:
+        if isinstance(x, Mapping):
+            return dict(x)
+        return {"": x}
+
+    # -- component instantiation (the Doer analog) --------------------------
+    def _doer(self, table: Mapping[str, type], kind: str,
+              name_params: Tuple[str, Params]):
+        name, params = name_params
+        if name not in table:
+            raise KeyError(
+                f"{kind} '{name}' is not registered in this engine; "
+                f"available: {sorted(table)}")
+        return table[name](params)
+
+    def make_components(self, engine_params: EngineParams):
+        ds = self._doer(self.data_source_classes, "DataSource",
+                        engine_params.data_source_params)
+        prep = self._doer(self.preparator_classes, "Preparator",
+                          engine_params.preparator_params)
+        algos = [self._doer(self.algorithm_classes, "Algorithm", ap)
+                 for ap in engine_params.algorithm_params_list]
+        if not algos:
+            raise ValueError("EngineParams specifies no algorithms")
+        serving = self._doer(self.serving_classes, "Serving",
+                             engine_params.serving_params)
+        return ds, prep, algos, serving
+
+    # -- train (Engine.scala:157-192 + 643-708) -----------------------------
+    def train(self, ctx: RuntimeContext,
+              engine_params: EngineParams) -> List[Any]:
+        ds, prep, algos, _ = self.make_components(engine_params)
+        wp = ctx.workflow_params
+        td = ds.read_training(ctx)
+        if not wp.skip_sanity_check:
+            sanity_check(td)
+        if wp.stop_after_read:
+            raise StopAfterReadInterruption()
+        pd = prep.prepare(ctx, td)
+        if not wp.skip_sanity_check:
+            sanity_check(pd)
+        if wp.stop_after_prepare:
+            raise StopAfterPrepareInterruption()
+        models = []
+        for algo in algos:       # sequential per-algo loop (Engine.scala:692)
+            model = algo.train(ctx, pd)
+            if not wp.skip_sanity_check:
+                sanity_check(model)
+            models.append(model)
+        return models
+
+    # -- eval (Engine.scala:730-820) ----------------------------------------
+    def eval(self, ctx: RuntimeContext, engine_params: EngineParams
+             ) -> List[Tuple[Any, Sequence[Tuple[Any, Any, Any]]]]:
+        """Returns [(evalInfo, [(query, prediction, actual)])] per fold."""
+        ds, prep, algos, serving = self.make_components(engine_params)
+        folds = ds.read_eval(ctx)
+        out = []
+        for td, eval_info, qa_pairs in folds:
+            pd = prep.prepare(ctx, td)
+            models = [a.train(ctx, pd) for a in algos]
+            queries = [(i, serving.supplement(q))
+                       for i, (q, _) in enumerate(qa_pairs)]
+            # per-algo batched inference, joined by query index
+            # (union + groupByKey in the reference, Engine.scala:790-796)
+            per_algo: List[Dict[int, Any]] = []
+            for algo, model in zip(algos, models):
+                per_algo.append(dict(algo.batch_predict(model, queries)))
+            qpa = []
+            for i, (q, a) in enumerate(qa_pairs):
+                preds = [pa[i] for pa in per_algo]
+                qpa.append((q, serving.serve(q, preds), a))
+            out.append((eval_info, qpa))
+        return out
+
+    # -- JSON variant -> EngineParams (Engine.scala:357-420) ----------------
+    def engine_params_from_variant(self, variant: "Mapping | str"
+                                   ) -> EngineParams:
+        if isinstance(variant, str):
+            variant = json.loads(variant)
+
+        def one(table, kind, node) -> Tuple[str, Params]:
+            if node is None:
+                name = ""
+                params_json: Any = {}
+            else:
+                name = node.get("name", "")
+                params_json = node.get("params", {})
+            if name not in table:
+                if len(table) == 1 and name == "":
+                    name = next(iter(table))
+                else:
+                    raise ParamsError(
+                        f"{kind} '{name}' not registered; "
+                        f"available: {sorted(table)}")
+            cls = table[name]
+            pcls = getattr(cls, "params_class", None)
+            if pcls is None:
+                raise ParamsError(f"{kind} {cls.__name__} has no params_class")
+            return name, extract_params(pcls, params_json, f"$.{kind.lower()}")
+
+        algo_nodes = variant.get("algorithms") or []
+        if not algo_nodes:
+            # a single unnamed algorithm with default params
+            algo_nodes = [{"name": "", "params": {}}]
+        return EngineParams(
+            data_source_params=one(self.data_source_classes, "Datasource",
+                                   variant.get("datasource")),
+            preparator_params=one(self.preparator_classes, "Preparator",
+                                  variant.get("preparator")),
+            algorithm_params_list=tuple(
+                one(self.algorithm_classes, "Algorithm", n)
+                for n in algo_nodes),
+            serving_params=one(self.serving_classes, "Serving",
+                               variant.get("serving")),
+        )
+
+
+class SimpleEngine(Engine):
+    """DataSource + one Algorithm, identity prep, first serving
+    (Engine.scala SimpleEngine:838-855)."""
+
+    def __init__(self, data_source: Type[DataSource],
+                 algorithm: Type[Algorithm]):
+        from predictionio_tpu.core.base import FirstServing, IdentityPreparator
+        super().__init__(data_source, IdentityPreparator, algorithm,
+                         FirstServing)
+
+
+class EngineFactory:
+    """Subclass and override `apply()` to return an Engine; referenced by
+    dotted name from engine.json's engineFactory
+    (controller/EngineFactory.scala)."""
+
+    @classmethod
+    def apply(cls) -> Engine:
+        raise NotImplementedError

@@ -1,0 +1,158 @@
+"""Typed parameter classes and JSON extraction.
+
+Parity targets:
+  - `Params` marker + `EmptyParams` (`core/.../controller/Params.scala`)
+  - `EngineParams` 4-tuple of named component params
+    (`core/.../controller/EngineParams.scala:25-65`)
+  - typed JSON -> params extraction with precise error messages
+    (`core/.../workflow/JsonExtractor.scala:1-167`,
+    `WorkflowUtils.extractParams:123-152`). The reference needed a dual
+    Json4s/Gson extractor to cover Scala and Java params classes; here one
+    dataclass-driven extractor covers everything, including nested
+    dataclasses, Optionals, sequences and mappings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type, TypeVar
+
+
+class Params:
+    """Marker base for component parameter classes; subclasses are
+    `@dataclass`es. (Params.scala:25)"""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """(EmptyParams, Params.scala:30)"""
+
+
+T = TypeVar("T")
+
+
+class ParamsError(ValueError):
+    """Extraction failure with a JSON-path-qualified message."""
+
+
+def _type_name(tp) -> str:
+    return getattr(tp, "__name__", str(tp))
+
+
+def extract_params(cls: Type[T], obj: Any, path: str = "$") -> T:
+    """Build `cls` (a Params dataclass) from parsed JSON `obj`.
+
+    Unknown keys are rejected (the reference's Json4sNative extractor
+    silently ignored them, which the docs call out as a source of silent
+    misconfiguration — strictness here is deliberate and tested)."""
+    if isinstance(obj, str):
+        obj = json.loads(obj) if obj.strip() else {}
+    if obj is None:
+        obj = {}
+    if not isinstance(obj, Mapping):
+        raise ParamsError(
+            f"{path}: expected an object for {_type_name(cls)}, "
+            f"got {type(obj).__name__}")
+    if not dataclasses.is_dataclass(cls):
+        raise ParamsError(f"{path}: {_type_name(cls)} is not a params dataclass")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(obj) - set(fields)
+    if unknown:
+        raise ParamsError(
+            f"{path}: unknown field(s) {sorted(unknown)} for "
+            f"{_type_name(cls)}; known: {sorted(fields)}")
+    hints = typing.get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    for name, f in fields.items():
+        if name in obj:
+            kwargs[name] = _coerce(hints.get(name, Any), obj[name],
+                                   f"{path}.{name}")
+        elif (f.default is dataclasses.MISSING
+              and f.default_factory is dataclasses.MISSING):
+            raise ParamsError(
+                f"{path}: missing required field '{name}' "
+                f"({_type_name(hints.get(name, Any))}) for {_type_name(cls)}")
+    try:
+        return cls(**kwargs)
+    except (TypeError, ValueError) as e:
+        raise ParamsError(f"{path}: cannot construct {_type_name(cls)}: {e}")
+
+
+def _coerce(tp, value: Any, path: str) -> Any:
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if tp is Any or tp is None:
+        return value
+    if origin is typing.Union:
+        if value is None:
+            if type(None) in args:
+                return None
+            raise ParamsError(f"{path}: null not allowed for {tp}")
+        errors = []
+        for cand in (a for a in args if a is not type(None)):
+            try:
+                return _coerce(cand, value, path)
+            except ParamsError as e:
+                errors.append(str(e))
+        raise ParamsError(f"{path}: no Union arm matched: {errors}")
+    if dataclasses.is_dataclass(tp):
+        return extract_params(tp, value, path)
+    if origin in (list, tuple, typing.Sequence) or tp in (list, tuple):
+        if not isinstance(value, (list, tuple)):
+            raise ParamsError(
+                f"{path}: expected array, got {type(value).__name__}")
+        elem = args[0] if args else Any
+        out = [_coerce(elem, v, f"{path}[{i}]") for i, v in enumerate(value)]
+        return tuple(out) if origin is tuple or tp is tuple else out
+    if origin in (dict, typing.Mapping) or tp is dict:
+        if not isinstance(value, Mapping):
+            raise ParamsError(
+                f"{path}: expected object, got {type(value).__name__}")
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _coerce(vt, v, f"{path}.{k}") for k, v in value.items()}
+    if tp is bool:
+        if not isinstance(value, bool):
+            raise ParamsError(
+                f"{path}: expected bool, got {type(value).__name__}")
+        return value
+    if tp is int:
+        if isinstance(value, bool) or not isinstance(value, int):
+            if isinstance(value, float) and value.is_integer():
+                return int(value)
+            raise ParamsError(
+                f"{path}: expected int, got {type(value).__name__}")
+        return value
+    if tp is float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ParamsError(
+                f"{path}: expected number, got {type(value).__name__}")
+        return float(value)
+    if tp is str:
+        if not isinstance(value, str):
+            raise ParamsError(
+                f"{path}: expected string, got {type(value).__name__}")
+        return value
+    return value
+
+
+def params_to_json(p: Optional[Params]) -> str:
+    """Serialize a params dataclass back to JSON (for instance metadata)."""
+    if p is None:
+        return "{}"
+    return json.dumps(dataclasses.asdict(p), sort_keys=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Named component params for one engine variant
+    (EngineParams.scala:25-65): (component name, params) pairs; algorithms
+    is a list so one engine can run several algorithms at once."""
+    data_source_params: Tuple[str, Params] = ("", EmptyParams())
+    preparator_params: Tuple[str, Params] = ("", EmptyParams())
+    algorithm_params_list: Sequence[Tuple[str, Params]] = ()
+    serving_params: Tuple[str, Params] = ("", EmptyParams())
+
+    def with_(self, **kw) -> "EngineParams":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,130 @@
+"""Model persistence: the Kryo-blob + PersistentModel analog.
+
+Parity targets:
+  - Kryo serialization of the per-instance model list
+    (`core/.../workflow/CoreWorkflow.scala:76-81`, `CreateServer.scala:58-72`)
+  - `PersistentModel`/`PersistentModelLoader` custom save/load
+    (`core/.../controller/PersistentModel.scala:30-115`)
+  - `PersistentModelManifest` marker stored in place of bytes
+    (`core/.../workflow/PersistentModelManifest.scala`)
+
+Implementation: one pickle blob per engine instance containing the list of
+per-algorithm entries. jax.Arrays are converted to numpy on save and live
+as numpy until an algorithm moves them back to device (device placement is
+a serving-time decision — the mesh at deploy time may differ from the mesh
+at train time). Models implementing `PersistentModel` save themselves
+(e.g. to a directory of .npz shards) and only their manifest enters the
+blob; models of algorithms with `persist_model=False` store a retrain
+marker, reproducing the reference's retrain-on-deploy semantics
+(`Engine.scala:211-233`).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class PersistentModelManifest:
+    """Marker stored instead of model bytes (PersistentModelManifest.scala)."""
+    class_module: str
+    class_name: str
+
+
+@dataclass(frozen=True)
+class RetrainMarker:
+    """Stored for persist_model=False algorithms: deploy retrains
+    (the reference's `Unit` model, Engine.scala:286-304)."""
+
+
+class PersistentModel:
+    """Custom save/load contract (PersistentModel.scala:30-115).
+
+    Implementors define:
+      save(instance_id, params, ctx) -> bool   (False = fall back to blob)
+      @classmethod load(cls, instance_id, params, ctx) -> model
+    """
+
+    def save(self, instance_id: str, params, ctx) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, instance_id: str, params, ctx):
+        raise NotImplementedError
+
+
+class _JaxAwarePickler(pickle.Pickler):
+    """Pickle with jax.Array -> numpy conversion at save time."""
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        try:
+            import jax
+            if isinstance(obj, jax.Array):
+                import numpy as np
+                return (np.asarray, (np.asarray(obj),))
+        except ImportError:  # pragma: no cover
+            pass
+        return NotImplemented
+
+
+def dumps(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    _JaxAwarePickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def serialize_models(instance_id: str, algorithms: Sequence, models: Sequence,
+                     ctx) -> bytes:
+    """Decide per-algorithm persistence and produce the instance blob
+    (Engine.makeSerializableModels, Engine.scala:286-304)."""
+    entries: List[Any] = []
+    for algo, model in zip(algorithms, models):
+        if isinstance(model, PersistentModel):
+            if model.save(instance_id, algo.params, ctx):
+                cls = type(model)
+                entries.append(PersistentModelManifest(
+                    cls.__module__, cls.__qualname__))
+            else:
+                entries.append(model)
+        elif not getattr(algo, "persist_model", True):
+            entries.append(RetrainMarker())
+        else:
+            entries.append(model)
+    return dumps(entries)
+
+
+def deserialize_models(blob: bytes, instance_id: str, algorithms: Sequence,
+                       ctx, retrain) -> List[Any]:
+    """Invert serialize_models at deploy time
+    (Engine.prepareDeploy, Engine.scala:199-269).
+
+    `retrain` is a callback () -> List[model] used when any algorithm
+    stored a RetrainMarker; it re-runs read/prepare/train once and the
+    fresh models replace every marker."""
+    entries = loads(blob)
+    needs_retrain = any(isinstance(e, RetrainMarker) for e in entries)
+    fresh: Optional[List[Any]] = retrain() if needs_retrain else None
+    out: List[Any] = []
+    for i, (entry, algo) in enumerate(zip(entries, algorithms)):
+        if isinstance(entry, PersistentModelManifest):
+            import importlib
+            mod = importlib.import_module(entry.class_module)
+            cls = mod
+            for part in entry.class_name.split("."):
+                cls = getattr(cls, part)
+            out.append(cls.load(instance_id, algo.params, ctx))
+        elif isinstance(entry, RetrainMarker):
+            out.append(fresh[i])
+        else:
+            out.append(entry)
+    return out

@@ -1,0 +1,62 @@
+"""RuntimeContext: the per-run execution context handed to DASE components.
+
+The reference passed a `SparkContext` into every Base* method and built it
+per workflow run (`core/.../workflow/WorkflowContext.scala:27-46`). The
+TPU-native analog bundles:
+  - the device `Mesh` all jit'd compute shards over,
+  - the `StorageRegistry` (event/meta/model repositories),
+  - `WorkflowParams` (verbosity, sanity-check and stop-after flags — parity
+    with `core/.../workflow/WorkflowParams.scala`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+from predictionio_tpu.parallel import MeshSpec, make_mesh
+
+
+@dataclass(frozen=True)
+class WorkflowParams:
+    """(WorkflowParams.scala:25-40; sparkEnv -> runtime_conf)"""
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+
+
+class RuntimeContext:
+    """Execution context for one train/eval/serve run."""
+
+    def __init__(self, registry=None, mesh=None,
+                 workflow_params: Optional[WorkflowParams] = None):
+        self._registry = registry
+        self._mesh = mesh
+        self.workflow_params = workflow_params or WorkflowParams()
+
+    @property
+    def registry(self):
+        if self._registry is None:
+            from predictionio_tpu.data.storage import storage
+            self._registry = storage()
+        return self._registry
+
+    @property
+    def mesh(self):
+        """The device mesh, built lazily from runtime_conf's 'mesh' spec
+        (the analog of WorkflowContext building the SparkContext)."""
+        if self._mesh is None:
+            spec = MeshSpec.from_conf(dict(self.workflow_params.runtime_conf))
+            self._mesh = make_mesh(spec)
+        return self._mesh
+
+    def with_mesh(self, mesh) -> "RuntimeContext":
+        ctx = RuntimeContext(self._registry, mesh, self.workflow_params)
+        return ctx
+
+    @property
+    def event_store(self):
+        return self.registry.get_events()

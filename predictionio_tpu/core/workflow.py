@@ -1,0 +1,160 @@
+"""Train/eval orchestration around the storage registries.
+
+Parity targets:
+  - `CoreWorkflow.runTrain` / `runEvaluation`
+    (`core/.../workflow/CoreWorkflow.scala:45-160`)
+  - engine factory reflection (`CreateWorkflow.scala:195-203`,
+    `WorkflowUtils.getEngine`)
+  - deploy-time model preparation (`Engine.prepareDeploy`,
+    `controller/Engine.scala:199-269`)
+"""
+
+from __future__ import annotations
+
+import importlib
+import traceback
+from typing import Any, List, Optional, Tuple
+
+from predictionio_tpu.core.engine import Engine, EngineFactory
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.core.persistence import (
+    deserialize_models, serialize_models,
+)
+from predictionio_tpu.core.runtime import RuntimeContext
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.data.storage.base import (
+    EngineInstance, EngineInstanceStatus, Model,
+)
+
+# explicit registry complementing dotted-path import, so quickstart factories
+# can register under short names (the classpath-reflection analog)
+_ENGINE_FACTORIES = {}
+
+
+def register_engine(name: str, factory) -> None:
+    _ENGINE_FACTORIES[name] = factory
+
+
+def resolve_engine(factory_name: str) -> Engine:
+    """Resolve an engine factory by registered short name or dotted path
+    'package.module.FactoryClass' (WorkflowUtils.getEngine analog)."""
+    target = _ENGINE_FACTORIES.get(factory_name)
+    if target is None:
+        module_name, _, attr = factory_name.rpartition(".")
+        if not module_name:
+            raise ValueError(
+                f"Unknown engine factory {factory_name!r}; registered: "
+                f"{sorted(_ENGINE_FACTORIES)} (or use a dotted path)")
+        mod = importlib.import_module(module_name)
+        target = getattr(mod, attr)
+    if isinstance(target, Engine):
+        return target
+    if isinstance(target, type) and issubclass(target, EngineFactory):
+        return target.apply()
+    if callable(target):
+        result = target()
+        if isinstance(result, Engine):
+            return result
+    raise TypeError(f"{factory_name!r} did not produce an Engine")
+
+
+class CoreWorkflow:
+    """Training orchestration with engine-instance lifecycle."""
+
+    @staticmethod
+    def run_train(engine: Engine, engine_params: EngineParams,
+                  ctx: RuntimeContext, *,
+                  engine_factory: str = "",
+                  engine_variant: str = "",
+                  verbose_save: bool = True) -> EngineInstance:
+        """Train, persist models, record the instance
+        (CoreWorkflow.scala:45-101): insert INIT row, train, serialize
+        models into the model repo, update status to COMPLETED; any failure
+        leaves the row non-COMPLETED so deploy refuses it
+        (commands/Engine.scala:235-236)."""
+        registry = ctx.registry
+        instances = registry.get_meta_data_engine_instances()
+        row = EngineInstance(
+            id="", status=EngineInstanceStatus.INIT,
+            start_time=utcnow(), end_time=utcnow(),
+            engine_id="default", engine_version="default",
+            engine_variant=engine_variant or "default",
+            engine_factory=engine_factory,
+            batch=ctx.workflow_params.batch,
+            env={}, runtime_conf=dict(ctx.workflow_params.runtime_conf),
+            data_source_params=_named_params_json(
+                engine_params.data_source_params),
+            preparator_params=_named_params_json(
+                engine_params.preparator_params),
+            algorithms_params=_algo_params_json(engine_params),
+            serving_params=_named_params_json(engine_params.serving_params),
+        )
+        instance_id = instances.insert(row)
+        row = row.with_(id=instance_id, status=EngineInstanceStatus.TRAINING)
+        instances.update(row)
+        try:
+            models = engine.train(ctx, engine_params)
+            _, _, algos, _ = engine.make_components(engine_params)
+            blob = serialize_models(instance_id, algos, models, ctx)
+            registry.get_model_data_models().insert(Model(instance_id, blob))
+            row = row.with_(status=EngineInstanceStatus.COMPLETED,
+                            end_time=utcnow())
+            instances.update(row)
+            return row
+        except Exception:
+            traceback.print_exc()
+            row = row.with_(status=EngineInstanceStatus.FAILED,
+                            end_time=utcnow())
+            instances.update(row)
+            raise
+
+    @staticmethod
+    def prepare_deploy(engine: Engine, instance: EngineInstance,
+                       ctx: RuntimeContext,
+                       engine_params: Optional[EngineParams] = None
+                       ) -> Tuple[List[Any], List[Any], Any]:
+        """Load (or retrain) the instance's models for serving; returns
+        (algorithms, models, serving). (Engine.prepareDeploy +
+        CreateServer.createServerActorWithEngine:186-244)."""
+        if engine_params is None:
+            engine_params = engine_params_from_instance(engine, instance)
+        _, _, algos, serving = engine.make_components(engine_params)
+        blob_row = ctx.registry.get_model_data_models().get(instance.id)
+        if blob_row is None:
+            raise ValueError(f"No model blob for instance {instance.id}")
+
+        def retrain() -> List[Any]:
+            return engine.train(ctx, engine_params)
+
+        models = deserialize_models(blob_row.models, instance.id, algos,
+                                    ctx, retrain)
+        return algos, models, serving
+
+
+def engine_params_from_instance(engine: Engine,
+                                instance: EngineInstance) -> EngineParams:
+    """Rebuild EngineParams from the params JSON recorded on the instance
+    (Engine.engineInstanceToEngineParams, Engine.scala:422-492)."""
+    import json
+    variant = {
+        "datasource": json.loads(instance.data_source_params or "{}"),
+        "preparator": json.loads(instance.preparator_params or "{}"),
+        "algorithms": json.loads(instance.algorithms_params or "[]"),
+        "serving": json.loads(instance.serving_params or "{}"),
+    }
+    return engine.engine_params_from_variant(variant)
+
+
+def _named_params_json(name_params) -> str:
+    import dataclasses
+    import json
+    name, p = name_params
+    return json.dumps({"name": name, "params": dataclasses.asdict(p)})
+
+
+def _algo_params_json(engine_params: EngineParams) -> str:
+    import dataclasses
+    import json
+    return json.dumps([
+        {"name": name, "params": dataclasses.asdict(p)}
+        for name, p in engine_params.algorithm_params_list])

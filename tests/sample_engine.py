@@ -1,0 +1,163 @@
+"""Deterministic fake DASE components for core tests.
+
+The analog of the reference's test fixture family in
+`core/src/test/scala/.../controller/SampleEngine.scala` (489 LoC):
+integer-tagged data flows through every stage so full pipelines are
+checkable by value equality.
+
+Data scheme: TrainingData(id), ProcessedData(prep_id, td), Model(algo_id,
+pd) — each stage wraps its input, so the final model records the exact
+path taken.
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from predictionio_tpu.core import (
+    Algorithm, DataSource, Params, PersistentModel, Preparator, Serving,
+)
+
+
+@dataclass(frozen=True)
+class TD:
+    id: int = 0
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise AssertionError(f"TD({self.id}) failed sanity check")
+
+
+@dataclass(frozen=True)
+class PD:
+    prep_id: int
+    td: TD
+
+
+@dataclass(frozen=True)
+class Model:
+    algo_id: int
+    pd: PD
+    params_value: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    q: int = 0
+    supplemented: bool = False
+
+
+@dataclass(frozen=True)
+class Prediction:
+    algo_id: int
+    q: Query
+    model: Optional[Model] = None
+
+
+@dataclass(frozen=True)
+class SDataSourceParams(Params):
+    id: int = 0
+    error: bool = False
+
+
+class SDataSource(DataSource):
+    params_class = SDataSourceParams
+
+    def read_training(self, ctx) -> TD:
+        return TD(self.params.id, self.params.error)
+
+    def read_eval(self, ctx):
+        folds = []
+        for fold in range(2):
+            td = TD(self.params.id + fold)
+            qa = [(Query(q=fold * 10 + i), fold * 10 + i) for i in range(3)]
+            folds.append((td, f"ei{fold}", qa))
+        return folds
+
+
+@dataclass(frozen=True)
+class SPreparatorParams(Params):
+    id: int = 1
+
+
+class SPreparator(Preparator):
+    params_class = SPreparatorParams
+
+    def prepare(self, ctx, td: TD) -> PD:
+        return PD(self.params.id, td)
+
+
+@dataclass(frozen=True)
+class SAlgoParams(Params):
+    id: int = 2
+    value: int = 0
+
+
+class SAlgo(Algorithm):
+    params_class = SAlgoParams
+    query_class = Query
+
+    def train(self, ctx, pd: PD) -> Model:
+        return Model(self.params.id, pd, self.params.value)
+
+    def predict(self, model: Model, query: Query) -> Prediction:
+        return Prediction(self.params.id, query, model)
+
+
+class SAlgoNoPersist(SAlgo):
+    """persist_model=False ≙ PAlgorithm returning a non-persistable model:
+    deploy must retrain (Engine.scala:211-233)."""
+    persist_model = False
+
+
+TRAIN_COUNTS = {"n": 0}
+
+
+class SAlgoCountingTrains(SAlgo):
+    persist_model = False
+
+    def train(self, ctx, pd: PD) -> Model:
+        TRAIN_COUNTS["n"] += 1
+        return super().train(ctx, pd)
+
+
+class SPersistentModel(Model, PersistentModel):
+    """A model with custom save/load, saved into an in-memory table
+    (PersistentModel.scala:30-115 analog)."""
+
+    STORE = {}
+
+    def save(self, instance_id, params, ctx) -> bool:
+        SPersistentModel.STORE[instance_id] = self
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params, ctx):
+        return SPersistentModel.STORE[instance_id]
+
+
+class SAlgoPersistent(SAlgo):
+    def train(self, ctx, pd: PD) -> Model:
+        return SPersistentModel(self.params.id, pd, self.params.value)
+
+
+@dataclass(frozen=True)
+class SServingParams(Params):
+    id: int = 3
+
+
+class SServing(Serving):
+    params_class = SServingParams
+
+    def supplement(self, query: Query) -> Query:
+        return Query(query.q, supplemented=True)
+
+    def serve(self, query: Query, predictions: Sequence[Prediction]):
+        return predictions[0]
+
+
+class SServingSum(Serving):
+    params_class = SServingParams
+
+    def serve(self, query: Query, predictions: Sequence[Prediction]):
+        return sum(p.algo_id for p in predictions)

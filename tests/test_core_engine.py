@@ -1,0 +1,283 @@
+"""Core engine/workflow tests.
+
+Mirrors `core/src/test/scala/.../controller/EngineTest.scala` (692 LoC):
+train model extraction (persistent-manifest vs retrain-marker vs plain),
+multi-algorithm train, eval Q/P/A flow, prepare_deploy retrain semantics —
+plus the params extractor matrix (`JsonExtractorSuite.scala`).
+"""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.core import (
+    CoreWorkflow, EmptyParams, Engine, EngineParams, FirstServing,
+    IdentityPreparator, Params, RuntimeContext, SimpleEngine, WorkflowParams,
+    StopAfterPrepareInterruption, StopAfterReadInterruption,
+    extract_params, register_engine, resolve_engine,
+)
+from predictionio_tpu.core.params import ParamsError
+from predictionio_tpu.core.workflow import engine_params_from_instance
+from predictionio_tpu.data.storage.base import EngineInstanceStatus
+
+import sample_engine as se
+
+
+def make_engine() -> Engine:
+    return Engine(
+        data_source={"": se.SDataSource, "ds2": se.SDataSource},
+        preparator=se.SPreparator,
+        algorithms={"algo": se.SAlgo, "nopersist": se.SAlgoNoPersist,
+                    "counting": se.SAlgoCountingTrains,
+                    "persistent": se.SAlgoPersistent},
+        serving={"": se.SServing, "sum": se.SServingSum},
+    )
+
+
+def ep(*algos) -> EngineParams:
+    return EngineParams(
+        data_source_params=("", se.SDataSourceParams(id=7)),
+        preparator_params=("", se.SPreparatorParams(id=8)),
+        algorithm_params_list=tuple(algos) or (("algo", se.SAlgoParams(id=9)),),
+        serving_params=("", se.SServingParams()),
+    )
+
+
+@pytest.fixture()
+def ctx(mem_registry):
+    return RuntimeContext(registry=mem_registry)
+
+
+class TestEngineTrain:
+    def test_train_value_flow(self, ctx):
+        models = make_engine().train(ctx, ep())
+        assert models == [se.Model(9, se.PD(8, se.TD(7)))]
+
+    def test_multi_algo_train(self, ctx):
+        models = make_engine().train(ctx, ep(
+            ("algo", se.SAlgoParams(id=1)),
+            ("algo", se.SAlgoParams(id=2, value=5)),
+        ))
+        assert [m.algo_id for m in models] == [1, 2]
+        assert models[1].params_value == 5
+
+    def test_sanity_check_raises(self, ctx):
+        with pytest.raises(AssertionError):
+            make_engine().train(ctx, EngineParams(
+                data_source_params=("", se.SDataSourceParams(error=True)),
+                algorithm_params_list=(("algo", se.SAlgoParams()),)))
+
+    def test_skip_sanity_check(self, mem_registry):
+        ctx = RuntimeContext(registry=mem_registry,
+                             workflow_params=WorkflowParams(
+                                 skip_sanity_check=True))
+        models = make_engine().train(ctx, EngineParams(
+            data_source_params=("", se.SDataSourceParams(error=True)),
+            algorithm_params_list=(("algo", se.SAlgoParams()),)))
+        assert len(models) == 1
+
+    def test_stop_after_read_and_prepare(self, mem_registry):
+        for flag, exc in [({"stop_after_read": True}, StopAfterReadInterruption),
+                          ({"stop_after_prepare": True},
+                           StopAfterPrepareInterruption)]:
+            ctx = RuntimeContext(registry=mem_registry,
+                                 workflow_params=WorkflowParams(**flag))
+            with pytest.raises(exc):
+                make_engine().train(ctx, ep())
+
+    def test_unknown_component_name(self, ctx):
+        with pytest.raises(KeyError):
+            make_engine().train(ctx, EngineParams(
+                algorithm_params_list=(("nosuch", se.SAlgoParams()),)))
+
+
+class TestEngineEval:
+    def test_eval_qpa_flow(self, ctx):
+        results = make_engine().eval(ctx, ep(
+            ("algo", se.SAlgoParams(id=1)),
+            ("algo", se.SAlgoParams(id=2))))
+        assert len(results) == 2  # two folds
+        ei0, qpa0 = results[0]
+        assert ei0 == "ei0"
+        assert len(qpa0) == 3
+        q, p, a = qpa0[0]
+        # serving picks the first algo's prediction; query passed to
+        # predict was supplemented
+        assert p.algo_id == 1
+        assert p.q.supplemented
+        assert a == q.q
+
+    def test_eval_serving_combines(self, ctx):
+        engine = make_engine()
+        params = ep(("algo", se.SAlgoParams(id=1)),
+                    ("algo", se.SAlgoParams(id=2))).with_(
+            serving_params=("sum", se.SServingParams()))
+        results = engine.eval(ctx, params)
+        _, qpa = results[0]
+        assert qpa[0][1] == 3  # 1 + 2
+
+
+class TestVariantExtraction:
+    def test_variant_roundtrip(self):
+        engine = make_engine()
+        variant = {
+            "datasource": {"params": {"id": 5}},
+            "preparator": {"params": {"id": 6}},
+            "algorithms": [
+                {"name": "algo", "params": {"id": 1, "value": 4}},
+                {"name": "nopersist", "params": {}},
+            ],
+            "serving": {"name": "sum", "params": {}},
+        }
+        p = engine.engine_params_from_variant(variant)
+        assert p.data_source_params == ("", se.SDataSourceParams(id=5))
+        assert p.algorithm_params_list[0] == ("algo", se.SAlgoParams(1, 4))
+        assert p.serving_params[0] == "sum"
+
+    def test_unknown_algo_name_rejected(self):
+        with pytest.raises(ParamsError):
+            make_engine().engine_params_from_variant(
+                {"algorithms": [{"name": "zzz", "params": {}}]})
+
+    def test_unknown_param_key_rejected(self):
+        with pytest.raises(ParamsError) as ei:
+            make_engine().engine_params_from_variant(
+                {"algorithms": [{"name": "algo", "params": {"idd": 3}}]})
+        assert "idd" in str(ei.value)
+
+
+class TestParamsExtractor:
+    def test_nested_and_optional(self):
+        from typing import Optional, Sequence
+
+        @dataclasses.dataclass(frozen=True)
+        class Inner(Params):
+            x: float
+
+        @dataclasses.dataclass(frozen=True)
+        class Outer(Params):
+            name: str
+            inner: Inner
+            opt: Optional[int] = None
+            seq: Sequence[str] = ()
+
+        p = extract_params(Outer, {"name": "a", "inner": {"x": 1},
+                                   "opt": None, "seq": ["u", "v"]})
+        assert p.inner.x == 1.0 and p.opt is None and tuple(p.seq) == ("u", "v")
+
+    def test_type_errors_have_paths(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            n: int
+
+        with pytest.raises(ParamsError) as ei:
+            extract_params(P, {"n": "nope"})
+        assert "$.n" in str(ei.value)
+        with pytest.raises(ParamsError) as ei:
+            extract_params(P, {})
+        assert "missing required field 'n'" in str(ei.value)
+
+    def test_bool_not_coerced_to_int(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            n: int
+
+        with pytest.raises(ParamsError):
+            extract_params(P, {"n": True})
+
+    def test_from_json_string(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            n: int = 3
+
+        assert extract_params(P, '{"n": 4}').n == 4
+        assert extract_params(P, "").n == 3
+
+
+class TestWorkflowPersistence:
+    def test_run_train_records_instance_and_models(self, ctx):
+        engine = make_engine()
+        row = CoreWorkflow.run_train(engine, ep(), ctx,
+                                     engine_factory="test.Factory")
+        assert row.status == EngineInstanceStatus.COMPLETED
+        instances = ctx.registry.get_meta_data_engine_instances()
+        latest = instances.get_latest_completed("default", "default", "default")
+        assert latest.id == row.id
+        blob = ctx.registry.get_model_data_models().get(row.id)
+        assert blob is not None
+
+    def test_failed_train_marks_failed(self, ctx):
+        engine = make_engine()
+        bad = EngineParams(
+            data_source_params=("", se.SDataSourceParams(error=True)),
+            algorithm_params_list=(("algo", se.SAlgoParams()),))
+        with pytest.raises(AssertionError):
+            CoreWorkflow.run_train(engine, bad, ctx)
+        instances = ctx.registry.get_meta_data_engine_instances()
+        assert instances.get_latest_completed(
+            "default", "default", "default") is None
+        assert instances.get_all()[0].status == EngineInstanceStatus.FAILED
+
+    def test_prepare_deploy_plain_model(self, ctx):
+        engine = make_engine()
+        row = CoreWorkflow.run_train(engine, ep(), ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        assert models == [se.Model(9, se.PD(8, se.TD(7)))]
+        pred = serving.serve(se.Query(1), [
+            a.predict(m, serving.supplement(se.Query(1)))
+            for a, m in zip(algos, models)])
+        assert pred.algo_id == 9 and pred.q.supplemented
+
+    def test_prepare_deploy_retrains_nonpersisted(self, ctx):
+        engine = make_engine()
+        se.TRAIN_COUNTS["n"] = 0
+        params = ep(("counting", se.SAlgoParams(id=4)))
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        assert se.TRAIN_COUNTS["n"] == 1
+        _, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        assert se.TRAIN_COUNTS["n"] == 2  # deploy retrained
+        assert models[0].algo_id == 4
+
+    def test_prepare_deploy_persistent_model(self, ctx):
+        engine = make_engine()
+        params = ep(("persistent", se.SAlgoParams(id=5, value=6)))
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        # blob contains only the manifest; actual model is in the side store
+        assert row.id in se.SPersistentModel.STORE
+        _, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        assert isinstance(models[0], se.SPersistentModel)
+        assert models[0].params_value == 6
+
+    def test_engine_params_roundtrip_through_instance(self, ctx):
+        engine = make_engine()
+        params = ep(("algo", se.SAlgoParams(id=2, value=9))).with_(
+            serving_params=("sum", se.SServingParams()))
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        rebuilt = engine_params_from_instance(engine, row)
+        assert rebuilt == params
+
+    def test_mixed_persistence_multi_algo(self, ctx):
+        engine = make_engine()
+        se.TRAIN_COUNTS["n"] = 0
+        params = ep(("algo", se.SAlgoParams(id=1)),
+                    ("counting", se.SAlgoParams(id=2)),
+                    ("persistent", se.SAlgoParams(id=3)))
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        _, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        assert [m.algo_id for m in models] == [1, 2, 3]
+        assert isinstance(models[2], se.SPersistentModel)
+
+
+class TestEngineResolution:
+    def test_registered_and_dotted(self):
+        engine = make_engine()
+        register_engine("sample", lambda: engine)
+        assert resolve_engine("sample") is engine
+
+    def test_simple_engine(self, ctx):
+        eng = SimpleEngine(se.SDataSource, se.SAlgo)
+        models = eng.train(ctx, EngineParams(
+            data_source_params=("", se.SDataSourceParams(id=1)),
+            algorithm_params_list=(("", se.SAlgoParams(id=2)),)))
+        # IdentityPreparator: PD is the TD itself
+        assert models[0].pd == se.TD(1)
