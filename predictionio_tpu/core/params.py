@@ -99,14 +99,22 @@ def _coerce(tp, value: Any, path: str) -> Any:
         raise ParamsError(f"{path}: no Union arm matched: {errors}")
     if dataclasses.is_dataclass(tp):
         return extract_params(tp, value, path)
-    if origin in (list, tuple, typing.Sequence) or tp in (list, tuple):
+    # typing.get_origin(Sequence[str]) is collections.abc.Sequence, and
+    # get_origin(Mapping[...]) is collections.abc.Mapping — match the abc,
+    # with Mapping checked first since dict-like abcs subclass Collection
+    import collections.abc as cabc
+    is_mapping_origin = (isinstance(origin, type)
+                         and issubclass(origin, cabc.Mapping))
+    is_seq_origin = (isinstance(origin, type) and not is_mapping_origin
+                     and issubclass(origin, cabc.Sequence))
+    if is_seq_origin or tp in (list, tuple):
         if not isinstance(value, (list, tuple)):
             raise ParamsError(
                 f"{path}: expected array, got {type(value).__name__}")
         elem = args[0] if args else Any
         out = [_coerce(elem, v, f"{path}[{i}]") for i, v in enumerate(value)]
         return tuple(out) if origin is tuple or tp is tuple else out
-    if origin in (dict, typing.Mapping) or tp is dict:
+    if is_mapping_origin or tp is dict:
         if not isinstance(value, Mapping):
             raise ParamsError(
                 f"{path}: expected object, got {type(value).__name__}")

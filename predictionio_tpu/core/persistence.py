@@ -24,7 +24,7 @@ from __future__ import annotations
 import io
 import pickle
 from dataclasses import dataclass
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Sequence
 
 
 @dataclass(frozen=True)
@@ -108,12 +108,13 @@ def deserialize_models(blob: bytes, instance_id: str, algorithms: Sequence,
     """Invert serialize_models at deploy time
     (Engine.prepareDeploy, Engine.scala:199-269).
 
-    `retrain` is a callback () -> List[model] used when any algorithm
-    stored a RetrainMarker; it re-runs read/prepare/train once and the
-    fresh models replace every marker."""
+    `retrain` is a callback (indices) -> {index: model} invoked only for
+    the algorithm positions that stored a RetrainMarker — read/prepare run
+    once, and only the marker algorithms pay a train."""
     entries = loads(blob)
-    needs_retrain = any(isinstance(e, RetrainMarker) for e in entries)
-    fresh: Optional[List[Any]] = retrain() if needs_retrain else None
+    marker_ix = [i for i, e in enumerate(entries)
+                 if isinstance(e, RetrainMarker)]
+    fresh: dict = retrain(marker_ix) if marker_ix else {}
     out: List[Any] = []
     for i, (entry, algo) in enumerate(zip(entries, algorithms)):
         if isinstance(entry, PersistentModelManifest):

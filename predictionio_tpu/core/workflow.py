@@ -118,13 +118,17 @@ class CoreWorkflow:
         CreateServer.createServerActorWithEngine:186-244)."""
         if engine_params is None:
             engine_params = engine_params_from_instance(engine, instance)
-        _, _, algos, serving = engine.make_components(engine_params)
+        ds, prep, algos, serving = engine.make_components(engine_params)
         blob_row = ctx.registry.get_model_data_models().get(instance.id)
         if blob_row is None:
             raise ValueError(f"No model blob for instance {instance.id}")
 
-        def retrain() -> List[Any]:
-            return engine.train(ctx, engine_params)
+        def retrain(indices):
+            # read/prepare once; train only the marker algorithms
+            # (Engine.prepareDeploy retrains Unit models, Engine.scala:211-233)
+            td = ds.read_training(ctx)
+            pd = prep.prepare(ctx, td)
+            return {i: algos[i].train(ctx, pd) for i in indices}
 
         models = deserialize_models(blob_row.models, instance.id, algos,
                                     ctx, retrain)

@@ -1,0 +1,66 @@
+"""Engine-facing event store facade.
+
+Parity: `data/.../store/PEventStore.scala:35-118` / `LEventStore.scala`
+— engines address data by APP NAME (+ optional channel name), which this
+facade resolves to ids (`store/Common.scala` appNameToId) before querying
+the underlying `EventStore` DAO. Training code then feeds the resulting
+iterator into `predictionio_tpu.ingest` column builders.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Sequence
+
+from predictionio_tpu.data.event import Event, PropertyMap
+
+
+class AppNotFoundError(ValueError):
+    pass
+
+
+def app_name_to_id(registry, app_name: str,
+                   channel_name: Optional[str] = None):
+    """(app_id, channel_id) from names (store/Common.scala:33-59)."""
+    app = registry.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise AppNotFoundError(
+            f"App {app_name!r} not found; create it with 'pio app new'")
+    channel_id = None
+    if channel_name is not None:
+        channels = registry.get_meta_data_channels().get_by_appid(app.id)
+        match = [c for c in channels if c.name == channel_name]
+        if not match:
+            raise AppNotFoundError(
+                f"Channel {channel_name!r} not found for app {app_name!r}")
+        channel_id = match[0].id
+    return app.id, channel_id
+
+
+def find_events(registry, app_name: str,
+                channel_name: Optional[str] = None,
+                **filters) -> Iterator[Event]:
+    """PEventStore.find analog; filters pass through to EventStore.find."""
+    app_id, channel_id = app_name_to_id(registry, app_name, channel_name)
+    return registry.get_events().find(app_id, channel_id, **filters)
+
+
+def aggregate_properties(registry, app_name: str, *, entity_type: str,
+                         channel_name: Optional[str] = None,
+                         **filters) -> Dict[str, PropertyMap]:
+    """PEventStore.aggregateProperties analog."""
+    app_id, channel_id = app_name_to_id(registry, app_name, channel_name)
+    return registry.get_events().aggregate_properties(
+        app_id, channel_id, entity_type=entity_type, **filters)
+
+
+def find_by_entity(registry, app_name: str, *, entity_type: str,
+                   entity_id: str, channel_name: Optional[str] = None,
+                   event_names: Optional[Sequence[str]] = None,
+                   limit: Optional[int] = None,
+                   latest_first: bool = True) -> Iterator[Event]:
+    """LEventStore.findByEntity analog — the serving-time read used by the
+    e-commerce template inside predict (`ECommAlgorithm.scala:331-430`)."""
+    app_id, channel_id = app_name_to_id(registry, app_name, channel_name)
+    return registry.get_events().find(
+        app_id, channel_id, entity_type=entity_type, entity_id=entity_id,
+        event_names=event_names, limit=limit, reversed=latest_first)

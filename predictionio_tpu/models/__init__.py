@@ -1,0 +1,19 @@
+"""Official engine templates — the workloads from the reference's
+`examples/` tree, rebuilt on the TPU ops (SURVEY.md §2.6):
+
+  recommendation.py    explicit ALS recommender with blacklist filtering
+                       (`examples/scala-parallel-recommendation/`)
+  similarproduct.py    implicit ALS + cooccurrence + like/dislike algos,
+                       multi-algorithm engine
+                       (`examples/scala-parallel-similarproduct/`)
+  classification.py    NaiveBayes / LogisticRegression / RandomForest on
+                       aggregated entity properties
+                       (`examples/scala-parallel-classification/`)
+  ecommerce.py         implicit ALS with serving-time constraint events,
+                       popularity fallback
+                       (`examples/scala-parallel-ecommercerecommendation/`)
+  twotower.py          two-tower neural recommender (new capability)
+
+Each module exposes an `engine()` factory and registers it under a short
+name with the workflow registry, so `engine.json` can reference either.
+"""

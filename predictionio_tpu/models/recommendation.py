@@ -1,0 +1,213 @@
+"""Recommendation template: explicit ALS with blacklist filtering.
+
+Parity target: `examples/scala-parallel-recommendation/blacklist-items/`
+  - DataSource reads `rate` and `buy` events, mapping buy -> rating 4.0
+    (`DataSource.scala:43-72`), with k-fold `readEval`
+    (`DataSource.scala:76-101`)
+  - ALSAlgorithm wraps MLlib explicit ALS (`ALSAlgorithm.scala:51-93`);
+    here `ops.als.als_train` — degree-bucketed batched-Cholesky ALS
+  - predict = top-N with blacklist filter, empty result for unknown users
+    (`ALSAlgorithm.scala:96-112`); batchPredict for eval (`:115-150`)
+  - wire format: query `{"user": "1", "num": 4}` ->
+    `{"itemScores": [{"item": "i", "score": s}]}`
+
+Query batching is the TPU win: `batch_predict` scores a whole query batch
+in one jit'd matmul+top_k, where the reference loops driver-side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm, DataSource, Engine, EngineFactory, FirstServing,
+    IdentityPreparator, Params, RuntimeContext, register_engine,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.ingest import RatingColumns
+from predictionio_tpu.ops import als
+from predictionio_tpu.ops.topk import NEG_INF, build_mask, topk_scores
+
+
+# -- queries and results (wire-format parity) -------------------------------
+
+@dataclass(frozen=True)
+class Query(Params):
+    user: str
+    num: int = 10
+    blackList: Optional[Sequence[str]] = None
+    whiteList: Optional[Sequence[str]] = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: Sequence[ItemScore] = ()
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    """Test-fold ratings of the query's user (Evaluation.scala)."""
+    ratings: Sequence[Tuple[str, float]] = ()
+
+
+# -- data source ------------------------------------------------------------
+
+@dataclass(frozen=True)
+class EvalParams(Params):
+    """(DataSourceEvalParams, DataSource.scala:30)"""
+    k_fold: int = 3
+    query_num: int = 10
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel: Optional[str] = None
+    buy_rating: float = 4.0
+    eval_params: Optional[EvalParams] = None
+
+
+class RecommendationDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def _ratings(self, ctx: RuntimeContext) -> RatingColumns:
+        p = self.params
+
+        def rating_of(e):
+            if e.event == "rate":
+                v = e.properties.get_opt("rating")
+                return float(v) if v is not None else None
+            if e.event == "buy":
+                return p.buy_rating   # buy counts as rating 4 (DataSource.scala:61-66)
+            return None
+
+        events = store.find_events(
+            ctx.registry, p.app_name, p.channel,
+            event_names=["rate", "buy"])
+        return RatingColumns.from_events(events, rating_of=rating_of,
+                                         dedup_last_wins=True)
+
+    def read_training(self, ctx: RuntimeContext) -> RatingColumns:
+        return self._ratings(ctx)
+
+    def read_eval(self, ctx: RuntimeContext):
+        """k-fold split by element index modulo (CrossValidation.scala:26-67
+        splitData semantics; queries ask for each test-fold user)."""
+        p = self.params
+        if p.eval_params is None:
+            raise ValueError("eval requires DataSourceParams.eval_params")
+        rc = self._ratings(ctx)
+        k = p.eval_params.k_fold
+        folds = []
+        idx = np.arange(rc.n)
+        for fold in range(k):
+            test_sel = idx % k == fold
+            train = RatingColumns(
+                rc.user_ix[~test_sel], rc.item_ix[~test_sel],
+                rc.rating[~test_sel], rc.t_millis[~test_sel],
+                rc.users, rc.items)
+            qa: List[Tuple[Query, ActualResult]] = []
+            test_users = np.unique(rc.user_ix[test_sel])
+            for u in test_users:
+                sel = test_sel & (rc.user_ix == u)
+                ratings = [(rc.items.inverse(int(i)), float(r))
+                           for i, r in zip(rc.item_ix[sel], rc.rating[sel])]
+                qa.append((Query(user=rc.users.inverse(int(u)),
+                                 num=p.eval_params.query_num),
+                           ActualResult(tuple(ratings))))
+            folds.append((train, f"fold{fold}", qa))
+        return folds
+
+
+# -- algorithm --------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+
+class ALSAlgorithm(Algorithm):
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: RuntimeContext, pd: RatingColumns) -> als.ALSModel:
+        p = self.params
+        if pd.n == 0:
+            raise ValueError(
+                "No rating events found; check appName and event import "
+                "(parity: ALSAlgorithm.scala:56-61 require non-empty)")
+        x, y = als.als_train(
+            pd, rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+        return als.ALSModel(x, y, pd.users, pd.items)
+
+    def predict(self, model: als.ALSModel, query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: als.ALSModel,
+                      queries: Sequence[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        """One jit'd matmul+top_k over the whole batch; unknown users get
+        empty results (ALSAlgorithm.scala:96-112 semantics)."""
+        known = [(i, q, model.users.get(q.user)) for i, q in queries]
+        out: List[Tuple[int, PredictedResult]] = [
+            (i, PredictedResult()) for i, _, u in known if u is None]
+        live = [(i, q, u) for i, q, u in known if u is not None]
+        if not live:
+            return out
+        n_items = model.item_factors.shape[0]
+        k = max(min(q.num, n_items) for _, q, _ in live)
+        vecs = model.user_factors[np.array([u for _, _, u in live])]
+        mask = np.ones((len(live), n_items), bool)
+        for row, (_, q, _) in enumerate(live):
+            mask[row] = build_mask(
+                n_items,
+                blacklist_ix=[ix for it in (q.blackList or ())
+                              if (ix := model.items.get(it)) is not None],
+                whitelist_ix=(
+                    None if q.whiteList is None else
+                    [ix for it in q.whiteList
+                     if (ix := model.items.get(it)) is not None]))[0]
+        scores, ixs = topk_scores(vecs, model.item_factors, mask, k=k)
+        scores, ixs = np.asarray(scores), np.asarray(ixs)
+        for row, (i, q, _) in enumerate(live):
+            items = []
+            for s, ix in zip(scores[row], ixs[row]):
+                if s <= NEG_INF / 2 or len(items) >= q.num:
+                    continue
+                items.append(ItemScore(model.items.inverse(int(ix)),
+                                       float(s)))
+            out.append((i, PredictedResult(tuple(items))))
+        return out
+
+
+# -- engine -----------------------------------------------------------------
+
+class RecommendationEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source=RecommendationDataSource,
+            preparator=IdentityPreparator,
+            algorithms={"als": ALSAlgorithm, "": ALSAlgorithm},
+            serving=FirstServing,
+        )
+
+
+def engine() -> Engine:
+    return RecommendationEngine.apply()
+
+
+register_engine("recommendation", RecommendationEngine)

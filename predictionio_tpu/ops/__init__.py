@@ -1,0 +1,19 @@
+"""Numerical kernels: the MLlib replacement, expressed as XLA programs.
+
+Each module provides the math for one algorithm family, consuming the
+dense column structs from `predictionio_tpu.ingest` and producing
+plain array models:
+
+  als.py          explicit + implicit alternating least squares
+                  (replaces Spark MLlib `ALS` / `ALS.trainImplicit`)
+  naive_bayes.py  multinomial/categorical Naive Bayes
+                  (replaces MLlib `NaiveBayes`, e2 CategoricalNaiveBayes)
+  logreg.py       logistic regression / softmax classifier
+  cooccur.py      item-item cooccurrence scoring
+                  (replaces the similarproduct template's self-join)
+  topk.py         masked top-k scoring used by every recommender's serve
+
+Design rules (see SURVEY.md §7): static bucket-padded shapes, batched
+linear algebra on the MXU, host Python only between jit'd steps, sharding
+by jax.sharding annotations — never per-element Python.
+"""

@@ -1,0 +1,65 @@
+"""Masked top-k scoring — the serve-time hot path of every recommender.
+
+The reference serves queries one at a time and even notes "TODO:
+Parallelize" (`core/.../workflow/CreateServer.scala:494`); its per-query
+work is a driver-side loop over `recommendProducts`
+(`examples/.../ALSAlgorithm.scala:96-112`). Here scoring is one jit'd
+program: a query batch of user vectors against the full item factor matrix
+(an MXU matmul), additive masks for blacklist/seen/whitelist filters, then
+`lax.top_k` — so batching queries is free.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_scores(user_vecs, item_factors, mask, *, k: int):
+    """scores = U @ Y^T with invalid items masked out.
+
+    user_vecs:    [b, rank]
+    item_factors: [n_items, rank]
+    mask:         [b, n_items] bool — True = item allowed for that query
+    Returns (scores [b, k], indexes [b, k]); masked-out slots score NEG_INF.
+    """
+    scores = user_vecs @ item_factors.T
+    scores = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_similar(query_vecs, item_factors, mask, *, k: int):
+    """Cosine-similarity top-k: used by the similarproduct template
+    (`examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala`
+    cosine scoring). query_vecs [b, rank] are typically item vectors."""
+    qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1, keepdims=True)
+                       + 1e-9)
+    fn = item_factors / (jnp.linalg.norm(item_factors, axis=-1, keepdims=True)
+                         + 1e-9)
+    scores = qn @ fn.T
+    scores = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+def build_mask(n_items: int,
+               blacklist_ix: Sequence[int] = (),
+               whitelist_ix: Optional[Sequence[int]] = None,
+               batch: int = 1) -> np.ndarray:
+    """Host-side mask assembly from index lists (unknown ids are resolved
+    to indexes by the caller via BiMap and simply absent here)."""
+    if whitelist_ix is not None:
+        mask = np.zeros(n_items, bool)
+        mask[np.asarray(list(whitelist_ix), int)] = True
+    else:
+        mask = np.ones(n_items, bool)
+    if len(blacklist_ix):
+        mask[np.asarray(list(blacklist_ix), int)] = False
+    return np.broadcast_to(mask, (batch, n_items))

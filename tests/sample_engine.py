@@ -111,6 +111,7 @@ class SAlgoNoPersist(SAlgo):
 
 
 TRAIN_COUNTS = {"n": 0}
+PERSISTED_TRAIN_COUNTS = {"n": 0}
 
 
 class SAlgoCountingTrains(SAlgo):
@@ -118,6 +119,15 @@ class SAlgoCountingTrains(SAlgo):
 
     def train(self, ctx, pd: PD) -> Model:
         TRAIN_COUNTS["n"] += 1
+        return super().train(ctx, pd)
+
+
+class SAlgoPersistedCounting(SAlgo):
+    """Persisted (blob) algorithm that counts trains: deploy must NOT
+    retrain it even when a sibling algorithm needs a retrain."""
+
+    def train(self, ctx, pd: PD) -> Model:
+        PERSISTED_TRAIN_COUNTS["n"] += 1
         return super().train(ctx, pd)
 
 

@@ -1,0 +1,234 @@
+"""ALS op correctness tests.
+
+Strategy (SURVEY.md §7 'Hard parts' — RMSE parity against an
+MLlib-equivalent reference): each half-step is checked against a direct
+per-row numpy normal-equation solve; full training is checked by fit
+quality on a synthetic low-rank matrix; the sharded path must agree with
+the single-device path.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.ops.topk import build_mask, topk_scores, topk_similar
+from predictionio_tpu.parallel import make_mesh
+
+
+def synthetic(n_users=40, n_items=30, rank=3, density=0.5, seed=1, noise=0.0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n_users, rank)
+    y = rng.randn(n_items, rank)
+    full = x @ y.T + noise * rng.randn(n_users, n_items)
+    mask = rng.rand(n_users, n_items) < density
+    u, i = np.nonzero(mask)
+    return (u.astype(np.int32), i.astype(np.int32),
+            full[u, i].astype(np.float32))
+
+
+def numpy_user_step(y, u_ix, i_ix, val, n_users, reg):
+    """Direct per-user normal-equation solve (the oracle)."""
+    rank = y.shape[1]
+    x = np.zeros((n_users, rank), np.float32)
+    for u in range(n_users):
+        sel = u_ix == u
+        if not sel.any():
+            continue
+        yu = y[i_ix[sel]]
+        a = yu.T @ yu + reg * sel.sum() * np.eye(rank)
+        b = yu.T @ val[sel]
+        x[u] = np.linalg.solve(a, b)
+    return x
+
+
+def numpy_user_step_implicit(y, u_ix, i_ix, val, n_users, reg, alpha):
+    rank = y.shape[1]
+    yty = y.T @ y
+    x = np.zeros((n_users, rank), np.float32)
+    for u in range(n_users):
+        sel = u_ix == u
+        if not sel.any():
+            continue
+        yu = y[i_ix[sel]]
+        c1 = alpha * val[sel]
+        a = yty + (yu * c1[:, None]).T @ yu + reg * sel.sum() * np.eye(rank)
+        b = yu.T @ (1.0 + c1)
+        x[u] = np.linalg.solve(a, b)
+    return x
+
+
+class TestHalfStepOracle:
+    def test_explicit_matches_numpy(self):
+        u_ix, i_ix, val = synthetic()
+        rng = np.random.RandomState(0)
+        y = rng.randn(30, 3).astype(np.float32)
+        # one explicit user half-step through the bucketed solver
+        x1, _ = als.als_train((u_ix, i_ix, val), 40, 30, rank=3,
+                              iterations=0, reg=0.1)
+        side = als._pack_side(u_ix, i_ix, val, 40)
+        import jax.numpy as jnp
+        x = np.zeros((40, 3), np.float32)
+        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
+                                        side.msk):
+            sol = als._solve_bucket(
+                jnp.asarray(y), jnp.asarray(idx), jnp.asarray(vals),
+                jnp.asarray(msk), jnp.float32(0.1), jnp.float32(1.0),
+                jnp.zeros((3, 3), jnp.float32), implicit=False)
+            x[rows] = np.asarray(sol)
+        oracle = numpy_user_step(y, u_ix, i_ix, val, 40, 0.1)
+        np.testing.assert_allclose(x, oracle, rtol=2e-3, atol=2e-3)
+
+    def test_implicit_matches_numpy(self):
+        u_ix, i_ix, val = synthetic()
+        val = np.abs(val)
+        rng = np.random.RandomState(0)
+        y = rng.randn(30, 3).astype(np.float32)
+        side = als._pack_side(u_ix, i_ix, val, 40)
+        import jax.numpy as jnp
+        yty = jnp.asarray(y.T @ y)
+        x = np.zeros((40, 3), np.float32)
+        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
+                                        side.msk):
+            sol = als._solve_bucket(
+                jnp.asarray(y), jnp.asarray(idx), jnp.asarray(vals),
+                jnp.asarray(msk), jnp.float32(0.1), jnp.float32(2.0),
+                yty, implicit=True)
+            x[rows] = np.asarray(sol)
+        oracle = numpy_user_step_implicit(y, u_ix, i_ix, val, 40, 0.1, 2.0)
+        np.testing.assert_allclose(x, oracle, rtol=2e-3, atol=2e-3)
+
+
+class TestTraining:
+    def test_explicit_fits_low_rank(self):
+        u_ix, i_ix, val = synthetic(density=0.6)
+        x, y = als.als_train((u_ix, i_ix, val), 40, 30, rank=6,
+                             iterations=12, reg=0.01)
+        err = als.rmse(x, y, u_ix, i_ix, val)
+        assert err < 0.15, f"train RMSE {err}"
+
+    def test_rmse_decreases_with_iterations(self):
+        u_ix, i_ix, val = synthetic(density=0.6, noise=0.1)
+        errs = []
+        for iters in (1, 4, 10):
+            x, y = als.als_train((u_ix, i_ix, val), 40, 30, rank=5,
+                                 iterations=iters, reg=0.05, seed=3)
+            errs.append(als.rmse(x, y, u_ix, i_ix, val))
+        assert errs[2] <= errs[0] + 1e-6
+
+    def test_implicit_ranks_observed_above_unobserved(self):
+        # 20 users, 15 items; user u likes items u%5*3..+2
+        rows, cols = [], []
+        for u in range(20):
+            for j in range(3):
+                rows.append(u)
+                cols.append((u % 5) * 3 + j)
+        u_ix = np.array(rows, np.int32)
+        i_ix = np.array(cols, np.int32)
+        val = np.ones(len(rows), np.float32)
+        x, y = als.als_train((u_ix, i_ix, val), 20, 15, rank=8,
+                             iterations=10, reg=0.01, implicit=True,
+                             alpha=40.0)
+        scores = x @ y.T
+        for u in range(20):
+            liked = scores[u, (u % 5) * 3:(u % 5) * 3 + 3].mean()
+            others = np.delete(scores[u],
+                               range((u % 5) * 3, (u % 5) * 3 + 3)).mean()
+            assert liked > others
+
+    def test_bucketing_heavy_tail(self):
+        # one power user with 600 ratings, the rest with ~5: exercises
+        # multiple degree buckets in one training run
+        rng = np.random.RandomState(7)
+        rows, cols, vals = [], [], []
+        for i in range(600):
+            rows.append(0)
+            cols.append(i % 50)
+            vals.append(rng.uniform(1, 5))
+        for u in range(1, 30):
+            for _ in range(5):
+                rows.append(u)
+                cols.append(rng.randint(50))
+                vals.append(rng.uniform(1, 5))
+        u_ix = np.array(rows, np.int32)
+        i_ix = np.array(cols, np.int32)
+        val = np.array(vals, np.float32)
+        side = als._pack_side(u_ix, i_ix, val, 30)
+        assert len(side.rows) >= 2  # at least two buckets
+        x, y = als.als_train((u_ix, i_ix, val), 30, 50, rank=4,
+                             iterations=3, reg=0.1)
+        assert np.isfinite(x).all() and np.isfinite(y).all()
+
+    def test_user_with_no_ratings_gets_zero_factors(self):
+        u_ix = np.array([0, 2], np.int32)
+        i_ix = np.array([0, 1], np.int32)
+        val = np.ones(2, np.float32)
+        x, _ = als.als_train((u_ix, i_ix, val), 4, 2, rank=3, iterations=2,
+                             reg=0.1)
+        assert np.allclose(x[1], 0) and np.allclose(x[3], 0)
+        assert not np.allclose(x[0], 0)
+
+    def test_sharded_matches_single_device(self):
+        u_ix, i_ix, val = synthetic(density=0.4)
+        mesh = make_mesh()
+        x0, y0 = als.als_train((u_ix, i_ix, val), 40, 30, rank=4,
+                               iterations=4, reg=0.05, seed=2)
+        x1, y1 = als.als_train((u_ix, i_ix, val), 40, 30, rank=4,
+                               iterations=4, reg=0.05, seed=2, mesh=mesh)
+        np.testing.assert_allclose(x0, x1, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(y0, y1, rtol=1e-3, atol=1e-3)
+
+    def test_implicit_unrated_phantom_items_do_not_bias(self):
+        # items that never appear in ratings must not contribute to the
+        # Gram matrix: scores must be ~identical with and without them
+        rows, cols = [], []
+        for u in range(10):
+            for j in range(3):
+                rows.append(u)
+                cols.append((u % 2) * 3 + j)
+        u_ix = np.array(rows, np.int32)
+        i_ix = np.array(cols, np.int32)
+        val = np.ones(len(rows), np.float32)
+        x0, y0 = als.als_train((u_ix, i_ix, val), 10, 6, rank=4,
+                               iterations=5, reg=0.05, implicit=True,
+                               alpha=10.0, seed=4)
+        x1, y1 = als.als_train((u_ix, i_ix, val), 10, 506, rank=4,
+                               iterations=5, reg=0.05, implicit=True,
+                               alpha=10.0, seed=4)
+        np.testing.assert_allclose(x0 @ y0[:6].T, x1 @ y1[:6].T,
+                                   rtol=1e-3, atol=1e-3)
+        assert np.allclose(y1[6:], 0)
+
+    def test_implicit_rejects_negative(self):
+        with pytest.raises(ValueError):
+            als.als_train((np.array([0], np.int32), np.array([0], np.int32),
+                           np.array([-1.0], np.float32)), 1, 1,
+                          implicit=True)
+
+
+class TestTopK:
+    def test_masked_topk_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        u = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(50, 8).astype(np.float32)
+        mask = build_mask(50, blacklist_ix=[3, 7], batch=4)
+        scores, ix = topk_scores(u, y, mask, k=5)
+        ref = u @ y.T
+        ref[:, [3, 7]] = -np.inf
+        for b in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(ix[b]), np.argsort(-ref[b])[:5])
+
+    def test_whitelist(self):
+        rng = np.random.RandomState(1)
+        u = rng.randn(1, 4).astype(np.float32)
+        y = rng.randn(20, 4).astype(np.float32)
+        mask = build_mask(20, whitelist_ix=[2, 5, 9], batch=1)
+        _, ix = topk_scores(u, y, mask, k=3)
+        assert set(np.asarray(ix[0]).tolist()) == {2, 5, 9}
+
+    def test_cosine_similar(self):
+        y = np.eye(6, 4, dtype=np.float32) + 0.01
+        q = y[2:3] * 5.0  # scaled copy of item 2: cosine ignores magnitude
+        mask = build_mask(6, blacklist_ix=[2], batch=1)  # exclude itself
+        _, ix = topk_similar(q, y, mask, k=1)
+        assert int(ix[0, 0]) != 2

@@ -29,6 +29,7 @@ def make_engine() -> Engine:
         preparator=se.SPreparator,
         algorithms={"algo": se.SAlgo, "nopersist": se.SAlgoNoPersist,
                     "counting": se.SAlgoCountingTrains,
+                    "pcounting": se.SAlgoPersistedCounting,
                     "persistent": se.SAlgoPersistent},
         serving={"": se.SServing, "sum": se.SServingSum},
     )
@@ -139,6 +140,23 @@ class TestVariantExtraction:
             make_engine().engine_params_from_variant(
                 {"algorithms": [{"name": "zzz", "params": {}}]})
 
+    def test_unknown_variant_keys_rejected(self):
+        # a typo'd top-level or node key must not silently fall back to
+        # defaults
+        with pytest.raises(ParamsError):
+            make_engine().engine_params_from_variant(
+                {"dataSource": {"params": {}}})
+        with pytest.raises(ParamsError):
+            make_engine().engine_params_from_variant(
+                {"algorithms": [{"name": "algo", "parms": {"id": 1}}]})
+
+    def test_known_variant_metadata_keys_allowed(self):
+        p = make_engine().engine_params_from_variant({
+            "id": "default", "description": "x",
+            "engineFactory": "whatever",
+            "algorithms": [{"name": "algo", "params": {"id": 1}}]})
+        assert p.algorithm_params_list[0][1].id == 1
+
     def test_unknown_param_key_rejected(self):
         with pytest.raises(ParamsError) as ei:
             make_engine().engine_params_from_variant(
@@ -184,6 +202,24 @@ class TestParamsExtractor:
 
         with pytest.raises(ParamsError):
             extract_params(P, {"n": True})
+
+    def test_sequence_rejects_scalar_and_wrong_elements(self):
+        from typing import Mapping, Optional, Sequence
+
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            items: Optional[Sequence[str]] = None
+            conf: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+        # a plain string must not pass as Sequence[str]
+        with pytest.raises(ParamsError):
+            extract_params(P, {"items": "i1"})
+        with pytest.raises(ParamsError):
+            extract_params(P, {"items": [1, 2]})
+        with pytest.raises(ParamsError):
+            extract_params(P, {"conf": "notadict"})
+        ok = extract_params(P, {"items": ["i1"], "conf": {"a": "b"}})
+        assert list(ok.items) == ["i1"] and ok.conf == {"a": "b"}
 
     def test_from_json_string(self):
         @dataclasses.dataclass(frozen=True)
@@ -266,6 +302,21 @@ class TestWorkflowPersistence:
         _, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
         assert [m.algo_id for m in models] == [1, 2, 3]
         assert isinstance(models[2], se.SPersistentModel)
+
+    def test_deploy_retrains_only_marker_algorithms(self, ctx):
+        engine = make_engine()
+        se.TRAIN_COUNTS["n"] = 0
+        se.PERSISTED_TRAIN_COUNTS["n"] = 0
+        params = ep(("pcounting", se.SAlgoParams(id=1)),
+                    ("counting", se.SAlgoParams(id=2)))
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        assert se.PERSISTED_TRAIN_COUNTS["n"] == 1
+        assert se.TRAIN_COUNTS["n"] == 1
+        _, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        # only the non-persisted algorithm retrains at deploy
+        assert se.TRAIN_COUNTS["n"] == 2
+        assert se.PERSISTED_TRAIN_COUNTS["n"] == 1
+        assert [m.algo_id for m in models] == [1, 2]
 
 
 class TestEngineResolution:

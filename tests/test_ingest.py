@@ -104,7 +104,9 @@ class TestLabeledPoints:
             props, feature_attrs=["attr0", "attr1", "attr2"],
             label_attr="plan", label_map={"s": 0.0, "t": 1.0})
         assert lp.features.shape == (3, 3)
-        assert lp.label.tolist() == [0.0, 1.0, 0.0]
+        # property aggregation is a dict; row order is entity-dependent
+        by_entity = {lp.entities.inverse(i): lp.label[i] for i in range(3)}
+        assert by_entity == {"u0": 0.0, "u1": 1.0, "u2": 0.0}
 
     def test_missing_attr_dropped(self):
         from predictionio_tpu.data.event import PropertyMap, DataMap

@@ -1,0 +1,176 @@
+"""Recommendation template end-to-end tests.
+
+The analog of the reference's quickstart integration scenario
+(`tests/pio_tests/scenarios/quickstart_test.py`): import MovieLens-style
+events, train through CoreWorkflow, deploy (prepare models), query with
+assertions — all against in-memory storage.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import (
+    CoreWorkflow, EngineParams, RuntimeContext, resolve_engine,
+)
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import recommendation as rec
+
+
+N_USERS, N_ITEMS = 30, 25
+
+
+@pytest.fixture()
+def ctx(mem_registry):
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "mlapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    # block structure: user u likes items with (i % 3 == u % 3) -> rating 5,
+    # others rating 1; rate ~40% of items; a few buy events
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if rng.rand() > 0.4:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    events.insert(Event(
+        event="buy", entity_type="user", entity_id="u0",
+        target_entity_type="item", target_entity_id="i0"), app_id)
+    return RuntimeContext(registry=mem_registry)
+
+
+def params(**algo):
+    defaults = dict(rank=8, num_iterations=8, lambda_=0.05, seed=1)
+    defaults.update(algo)
+    return EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="mlapp")),
+        algorithm_params_list=(("als", rec.ALSAlgorithmParams(**defaults)),),
+    )
+
+
+class TestTrainPredict:
+    def test_full_lifecycle(self, ctx):
+        engine = resolve_engine("recommendation")
+        row = CoreWorkflow.run_train(engine, params(), ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        model = models[0]
+        assert model.user_factors.shape[0] == N_USERS
+        # query: top-4 for u1; the block structure must surface i%3==1 items
+        q = rec.Query(user="u1", num=4)
+        res = serving.serve(q, [algos[0].predict(model, serving.supplement(q))])
+        assert len(res.itemScores) == 4
+        top_items = [int(s.item[1:]) % 3 for s in res.itemScores]
+        assert top_items.count(1) >= 3, res.itemScores
+        # scores sorted descending
+        scores = [s.score for s in res.itemScores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty(self, ctx):
+        engine = resolve_engine("recommendation")
+        row = CoreWorkflow.run_train(engine, params(), ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        res = algos[0].predict(models[0], rec.Query(user="nobody", num=4))
+        assert res.itemScores == ()
+
+    def test_blacklist_whitelist(self, ctx):
+        engine = resolve_engine("recommendation")
+        row = CoreWorkflow.run_train(engine, params(), ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        model = models[0]
+        base = algos[0].predict(model, rec.Query(user="u1", num=3))
+        banned = base.itemScores[0].item
+        res = algos[0].predict(model, rec.Query(
+            user="u1", num=3, blackList=[banned]))
+        assert banned not in [s.item for s in res.itemScores]
+        res = algos[0].predict(model, rec.Query(
+            user="u1", num=2, whiteList=["i0", "i1"]))
+        assert {s.item for s in res.itemScores} <= {"i0", "i1"}
+
+    def test_batch_predict_matches_single(self, ctx):
+        engine = resolve_engine("recommendation")
+        row = CoreWorkflow.run_train(engine, params(), ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        queries = [(i, rec.Query(user=f"u{i}", num=3)) for i in range(5)]
+        queries.append((5, rec.Query(user="ghost", num=3)))
+        batch = dict(algos[0].batch_predict(models[0], queries))
+        for i, q in queries:
+            single = algos[0].predict(models[0], q)
+            # scores may differ by float32 matmul tiling across batch sizes
+            assert [s.item for s in batch[i].itemScores] == \
+                   [s.item for s in single.itemScores]
+            np.testing.assert_allclose(
+                [s.score for s in batch[i].itemScores],
+                [s.score for s in single.itemScores], rtol=1e-5)
+
+    def test_train_quality_rmse(self, ctx):
+        """RMSE parity gate: reconstruct held-in ratings well."""
+        engine = resolve_engine("recommendation")
+        _, _, algos, _ = engine.make_components(params())
+        ds = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="mlapp"))
+        rc = ds.read_training(ctx)
+        from predictionio_tpu.ops import als
+        x, y = als.als_train(rc, rank=8, iterations=10, reg=0.05, seed=1)
+        err = als.rmse(x, y, rc.user_ix, rc.item_ix, rc.rating)
+        assert err < 0.35, f"train RMSE {err}"
+
+    def test_no_events_raises(self, mem_registry):
+        apps = mem_registry.get_meta_data_apps()
+        apps.insert(App(0, "empty"))
+        mem_registry.get_events().init(
+            apps.get_by_name("empty").id)
+        ctx2 = RuntimeContext(registry=mem_registry)
+        engine = resolve_engine("recommendation")
+        p = EngineParams(
+            data_source_params=("", rec.DataSourceParams(app_name="empty")),
+            algorithm_params_list=(("als", rec.ALSAlgorithmParams()),))
+        with pytest.raises(Exception):
+            CoreWorkflow.run_train(engine, p, ctx2)
+
+
+class TestEvalData:
+    def test_read_eval_folds(self, ctx):
+        ds = rec.RecommendationDataSource(rec.DataSourceParams(
+            app_name="mlapp",
+            eval_params=rec.EvalParams(k_fold=3, query_num=5)))
+        folds = ds.read_eval(ctx)
+        assert len(folds) == 3
+        total = ds.read_training(ctx).n
+        for train, ei, qa in folds:
+            assert train.n < total
+            assert qa, "every fold should produce queries"
+            q, a = qa[0]
+            assert isinstance(q, rec.Query) and q.num == 5
+            assert a.ratings
+        # folds partition the data: train sizes sum to (k-1) * total
+        assert sum(t.n for t, _, _ in folds) == (3 - 1) * total
+
+    def test_engine_eval_runs(self, ctx):
+        engine = resolve_engine("recommendation")
+        p = EngineParams(
+            data_source_params=("", rec.DataSourceParams(
+                app_name="mlapp",
+                eval_params=rec.EvalParams(k_fold=2, query_num=4))),
+            algorithm_params_list=(
+                ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=3)),))
+        results = engine.eval(ctx, p)
+        assert len(results) == 2
+        for ei, qpa in results:
+            for q, pred, actual in qpa:
+                assert isinstance(pred, rec.PredictedResult)
+
+
+class TestVariantJson:
+    def test_engine_json_shape(self):
+        engine = resolve_engine("recommendation")
+        p = engine.engine_params_from_variant({
+            "datasource": {"params": {"app_name": "mlapp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 12, "num_iterations": 5, "lambda_": 0.1}}],
+        })
+        assert p.algorithm_params_list[0][1].rank == 12
