@@ -1,0 +1,22 @@
+"""Prediction serving plane.
+
+The analog of the reference's engine server
+(`core/.../workflow/CreateServer.scala`, 701 LoC): a REST server answering
+`POST /queries.json` through the supplement -> predict-per-algorithm ->
+serve chain, with feedback-loop event posting, hot `/reload`, `/stop`,
+engine-server plugins, and per-request latency bookkeeping.
+
+TPU-first difference: the reference answers queries strictly one at a time
+and notes "TODO: Parallelize" (CreateServer.scala:494). Here an optional
+micro-batcher coalesces concurrent requests into one device batch (the
+algorithms' `batch_predict` is one jit'd matmul+top_k), so throughput
+scales with concurrency instead of degrading.
+"""
+
+from predictionio_tpu.serving.server import (  # noqa: F401
+    PredictionServer, ServerConfig,
+)
+from predictionio_tpu.serving.plugins import (  # noqa: F401
+    EngineServerPlugin, EngineServerPluginContext, OUTPUT_BLOCKER,
+    OUTPUT_SNIFFER, QueryInfo,
+)

@@ -1,0 +1,341 @@
+"""The prediction REST server.
+
+Parity: `core/.../workflow/CreateServer.scala` — MasterActor/ServerActor
+collapse into one HTTPServerBase with a swappable `_Deployment` (reload
+replaces it atomically, the `/reload` hot-swap of `ServerActor`,
+CreateServer.scala:316-342).
+
+Serve chain per request (CreateServer.scala:470-591): extract typed query
+-> serving.supplement -> per-algorithm predict -> serving.serve -> output
+blockers -> optional feedback event -> JSON. With `batch_window_ms > 0`
+concurrent requests are coalesced into one device batch through the
+algorithms' `batch_predict` (the reference's "TODO: Parallelize" answered
+with MXU batching).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import string
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from predictionio_tpu.core import RuntimeContext, extract_params
+from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
+from predictionio_tpu.data.event import format_time, utcnow
+from predictionio_tpu.serving.plugins import (
+    EngineServerPluginContext, QueryInfo,
+)
+from predictionio_tpu.utils.http import (
+    HTTPError, HTTPServerBase, Request, Response,
+)
+
+
+@dataclass
+class ServerConfig:
+    """(ServerConfig, CreateServer.scala:106-162)"""
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    engine_factory: str = ""
+    engine_variant: str = "default"
+    batch: str = ""
+    feedback: bool = False
+    event_server_ip: str = "localhost"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    batch_window_ms: int = 0     # 0 = serve each request immediately
+    batch_max: int = 64
+    verbose: bool = False
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Prediction/query dataclasses -> JSON-ready structures."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: to_jsonable(v)
+                for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: to_jsonable(v) for k, v in obj.items()}
+    if hasattr(obj, "item") and callable(getattr(obj, "item", None)) \
+            and type(obj).__module__ in ("numpy", "jax.numpy"):
+        return obj.item()   # numpy scalar
+    return obj
+
+
+class _Deployment:
+    """One loaded (engine, instance, algorithms, models, serving) set;
+    replaced wholesale by /reload."""
+
+    def __init__(self, engine, instance, algos, models, serving):
+        self.engine = engine
+        self.instance = instance
+        self.algos = algos
+        self.models = models
+        self.serving = serving
+        self.query_class = next(
+            (a.query_class for a in algos if a.query_class is not None), None)
+
+    def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
+        """supplement -> per-algo batch_predict -> serve, for a batch."""
+        supplemented = [self.serving.supplement(q) for q in queries]
+        indexed = list(enumerate(supplemented))
+        per_algo = [dict(a.batch_predict(m, indexed))
+                    for a, m in zip(self.algos, self.models)]
+        return [self.serving.serve(q, [pa[i] for pa in per_algo])
+                for i, q in enumerate(queries)]
+
+
+class _MicroBatcher:
+    """Coalesces concurrent requests into device batches."""
+
+    def __init__(self, window_s: float, batch_max: int):
+        self.window_s = window_s
+        self.batch_max = batch_max
+        self._lock = threading.Lock()
+        # each item: (deployment, query, done event, result slot)
+        self._pending: List[tuple] = []
+        self._worker: Optional[threading.Thread] = None
+
+    def submit(self, deployment: _Deployment, query: Any) -> Any:
+        done = threading.Event()
+        slot: Dict[str, Any] = {}
+        with self._lock:
+            self._pending.append((deployment, query, done, slot))
+            if len(self._pending) >= self.batch_max:
+                self._flush_locked()
+            elif self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._run_once,
+                                                daemon=True)
+                self._worker.start()
+        done.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def _run_once(self):
+        time.sleep(self.window_s)
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        # group by deployment (reload may swap mid-flight)
+        by_dep: Dict[int, List] = {}
+        for item in pending:
+            by_dep.setdefault(id(item[0]), []).append(item)
+        for items in by_dep.values():
+            dep = items[0][0]
+            queries = [q for _, q, _, _ in items]
+            try:
+                results = dep.predict_batch(queries)
+                for (_, _, done, slot), r in zip(items, results):
+                    slot["result"] = r
+                    done.set()
+            except Exception as e:
+                for _, _, done, slot in items:
+                    slot["error"] = e
+                    done.set()
+
+
+class PredictionServer(HTTPServerBase):
+    """(CreateServer.scala MasterActor+ServerActor)"""
+
+    def __init__(self, config: ServerConfig, registry=None,
+                 plugins: Optional[Sequence] = None,
+                 engine=None, instance=None):
+        super().__init__(host=config.ip, port=config.port)
+        self.config = config
+        self.ctx = RuntimeContext(registry=registry)
+        self.plugin_context = EngineServerPluginContext(plugins)
+        self._engine_arg = engine
+        self._dep: Optional[_Deployment] = None
+        self._dep_lock = threading.Lock()
+        self._batcher = (_MicroBatcher(config.batch_window_ms / 1000.0,
+                                       config.batch_max)
+                        if config.batch_window_ms > 0 else None)
+        # latency bookkeeping (CreateServer.scala:399-401,584-591)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = utcnow()
+        self._load(instance)
+        self._routes()
+
+    # -- deployment lifecycle ----------------------------------------------
+    def _resolve_instance(self):
+        instances = self.ctx.registry.get_meta_data_engine_instances()
+        inst = instances.get_latest_completed(
+            "default", "default", self.config.engine_variant)
+        if inst is None:
+            raise RuntimeError(
+                f"No valid engine instance found for variant "
+                f"{self.config.engine_variant}. Try running 'train' before "
+                "'deploy' (commands/Engine.scala:235-236)")
+        return inst
+
+    def _load(self, instance=None) -> None:
+        engine = (self._engine_arg if self._engine_arg is not None
+                  else resolve_engine(self.config.engine_factory))
+        if instance is None:
+            instance = self._resolve_instance()
+        algos, models, serving = CoreWorkflow.prepare_deploy(
+            engine, instance, self.ctx)
+        with self._dep_lock:
+            self._dep = _Deployment(engine, instance, algos, models, serving)
+
+    # -- serving -------------------------------------------------------------
+    def _serve_one(self, query_json: Any) -> Any:
+        t0 = time.perf_counter()
+        dep = self._dep
+        if dep.query_class is not None:
+            query = extract_params(dep.query_class, query_json)
+        else:
+            query = query_json
+        if self._batcher is not None:
+            prediction = self._batcher.submit(dep, query)
+        else:
+            prediction = dep.predict_batch([query])[0]
+        # feedback loop + prId injection (CreateServer.scala:506-576)
+        response_extra = {}
+        if self.config.feedback:
+            pr_id = getattr(prediction, "prId", None) or _gen_pr_id()
+            self._post_feedback(dep, query, prediction, pr_id)
+            if hasattr(prediction, "prId"):
+                response_extra["prId"] = pr_id
+        prediction = self.plugin_context.run_blockers(
+            QueryInfo(dep.instance.engine_variant, query, prediction))
+        self.plugin_context.notify_sniffers(
+            QueryInfo(dep.instance.engine_variant, query, prediction))
+        dt = time.perf_counter() - t0
+        self.request_count += 1
+        self.last_serving_sec = dt
+        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        out = to_jsonable(prediction)
+        if isinstance(out, dict):
+            out.update(response_extra)
+        return out
+
+    def _post_feedback(self, dep: _Deployment, query, prediction,
+                       pr_id: str) -> None:
+        """Async POST of the predict event back to the event server; send
+        failures are logged, not retried (CreateServer.scala:557-566)."""
+        data = {
+            "event": "predict",
+            "eventTime": format_time(utcnow()),
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {
+                "engineInstanceId": dep.instance.id,
+                "query": to_jsonable(query),
+                "prediction": to_jsonable(prediction),
+            },
+        }
+
+        def post():
+            import urllib.request
+            url = (f"http://{self.config.event_server_ip}:"
+                   f"{self.config.event_server_port}/events.json"
+                   f"?accessKey={self.config.access_key or ''}")
+            req = urllib.request.Request(
+                url, data=json.dumps(data).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    if resp.status != 201:
+                        self.log_request_line(
+                            f"Feedback event failed. Status: {resp.status}")
+            except Exception as e:
+                self.log_request_line(f"Feedback event failed: {e}")
+
+        threading.Thread(target=post, daemon=True).start()
+
+    # -- routes ---------------------------------------------------------------
+    def _routes(self) -> None:
+        r = self.router
+
+        @r.post("/queries.json")
+        def queries(req: Request) -> Response:
+            try:
+                payload = req.json()
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            return Response.json(self._serve_one(payload))
+
+        @r.get("/")
+        def index(req: Request) -> Response:
+            dep = self._dep
+            return Response.html(_status_page(self, dep))
+
+        @r.get("/status.json")
+        def status(req: Request) -> Response:
+            dep = self._dep
+            return Response.json({
+                "status": "alive",
+                "engineInstanceId": dep.instance.id,
+                "engineVariant": dep.instance.engine_variant,
+                "startTime": format_time(self.start_time),
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+            })
+
+        @r.post("/reload")
+        def reload(req: Request) -> Response:
+            """Hot-swap to the latest COMPLETED instance
+            (CreateServer.scala:316-342)."""
+            self._load()
+            return Response.json({"message": "Reloaded"})
+
+        @r.post("/stop")
+        def stop(req: Request) -> Response:
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            return Response.json({"message": "Shutting down"})
+
+        @r.get("/plugins.json")
+        def plugins_json(req: Request) -> Response:
+            return Response.json(self.plugin_context.describe())
+
+        def plugin_rest(req: Request) -> Response:
+            pname = req.params["pname"]
+            args = [a for a in req.params.get("args", "").split("/") if a]
+            table = {**self.plugin_context.output_blockers,
+                     **self.plugin_context.output_sniffers}
+            if pname not in table:
+                raise HTTPError(404, f"Unknown plugin {pname}")
+            return Response.json(table[pname].handle_rest(args))
+
+        r.get("/plugins/<pname>")(plugin_rest)
+        r.get("/plugins/<pname>/<args:path>")(plugin_rest)
+
+
+def _gen_pr_id() -> str:
+    return "".join(random.choices(string.ascii_letters + string.digits, k=64))
+
+
+def _status_page(server: PredictionServer, dep: _Deployment) -> str:
+    """Minimal HTML status page (the spray Twirl template analog,
+    CreateServer.scala:442-468)."""
+    algo_rows = "".join(
+        f"<tr><td>{type(a).__name__}</td><td>{a.params}</td></tr>"
+        for a in dep.algos)
+    return f"""<html><head><title>PredictionIO-TPU engine server</title></head>
+<body>
+<h1>Engine server is running</h1>
+<table>
+<tr><td>Engine instance</td><td>{dep.instance.id}</td></tr>
+<tr><td>Variant</td><td>{dep.instance.engine_variant}</td></tr>
+<tr><td>Started</td><td>{format_time(server.start_time)}</td></tr>
+<tr><td>Requests</td><td>{server.request_count}</td></tr>
+<tr><td>Average serving (s)</td><td>{server.avg_serving_sec:.6f}</td></tr>
+<tr><td>Last serving (s)</td><td>{server.last_serving_sec:.6f}</td></tr>
+</table>
+<h2>Algorithms</h2>
+<table>{algo_rows}</table>
+</body></html>"""

@@ -218,6 +218,9 @@ class HTTPServerBase:
             self._thread.join(timeout=5)
             self._thread = None
 
+    def is_running(self) -> bool:
+        return self._httpd is not None
+
     def log_request_line(self, line: str) -> None:
         pass
 

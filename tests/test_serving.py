@@ -1,0 +1,243 @@
+"""Prediction server tests over live HTTP.
+
+Covers the serve chain, feedback loop into a live event server, /reload
+hot-swap, /stop, plugins, micro-batching — the behaviors of
+`core/.../workflow/CreateServer.scala` exercised end-to-end the way the
+reference's integration suite does.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.eventserver import EventServer, EventServerConfig
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.serving import (
+    EngineServerPlugin, OUTPUT_BLOCKER, PredictionServer, ServerConfig,
+)
+from predictionio_tpu.serving.server import to_jsonable
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.fixture()
+def trained(mem_registry):
+    """Registry with a trained recommendation instance."""
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "servapp"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey("SKEY", app_id, ()))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="servapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4, seed=1)),))
+    row = CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine, row, app_id
+
+
+def start_server(registry, engine, **cfg):
+    config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+    srv = PredictionServer(config, registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+class TestServe:
+    def test_queries_json(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 3})
+            assert status == 200
+            assert len(body["itemScores"]) == 3
+            assert body["itemScores"][0]["score"] >= body["itemScores"][1]["score"]
+            # unknown user -> empty itemScores (ALSAlgorithm.scala:96-112)
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": "ghost", "num": 3})
+            assert status == 200 and body["itemScores"] == []
+        finally:
+            srv.shutdown()
+
+    def test_bad_query_400(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"nope": 1})
+            assert status == 400
+            status, _ = call(srv.port, "POST", "/queries.json",
+                             {"user": "u1", "num": "three"})
+            assert status == 400
+        finally:
+            srv.shutdown()
+
+    def test_status_and_latency_bookkeeping(self, trained):
+        registry, engine, row, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            call(srv.port, "POST", "/queries.json", {"user": "u1", "num": 2})
+            call(srv.port, "POST", "/queries.json", {"user": "u2", "num": 2})
+            status, body = call(srv.port, "GET", "/status.json")
+            assert status == 200
+            assert body["requestCount"] == 2
+            assert body["avgServingSec"] > 0
+            assert body["engineInstanceId"] == row.id
+            status, html = call(srv.port, "GET", "/")
+            assert status == 200 and "Engine server is running" in html
+        finally:
+            srv.shutdown()
+
+    def test_no_completed_instance_refuses(self, mem_registry):
+        with pytest.raises(RuntimeError, match="train"):
+            PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                             registry=mem_registry, engine=rec.engine())
+
+
+class TestReloadStop:
+    def test_reload_picks_latest(self, trained):
+        registry, engine, row1, app_id = trained
+        srv = start_server(registry, engine)
+        try:
+            assert srv._dep.instance.id == row1.id
+            # retrain -> new instance; /reload must pick it up
+            ctx = RuntimeContext(registry=registry)
+            params = EngineParams(
+                data_source_params=("", rec.DataSourceParams(
+                    app_name="servapp")),
+                algorithm_params_list=(
+                    ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=2,
+                                                   seed=2)),))
+            row2 = CoreWorkflow.run_train(engine, params, ctx)
+            status, _ = call(srv.port, "POST", "/reload")
+            assert status == 200
+            assert srv._dep.instance.id == row2.id
+        finally:
+            srv.shutdown()
+
+    def test_stop_endpoint(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        status, body = call(srv.port, "POST", "/stop")
+        assert status == 200
+        deadline = time.time() + 5
+        while srv.is_running() and time.time() < deadline:
+            time.sleep(0.05)
+        assert not srv.is_running()
+
+
+class TestFeedback:
+    def test_feedback_event_posted(self, trained):
+        registry, engine, row, app_id = trained
+        es = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                         registry)
+        es.start()
+        srv = start_server(
+            registry, engine, feedback=True,
+            event_server_ip="127.0.0.1", event_server_port=es.port,
+            access_key="SKEY")
+        try:
+            status, _ = call(srv.port, "POST", "/queries.json",
+                             {"user": "u1", "num": 2})
+            assert status == 200
+            deadline = time.time() + 5
+            found = []
+            while not found and time.time() < deadline:
+                found = list(registry.get_events().find(
+                    app_id, event_names=["predict"]))
+                time.sleep(0.05)
+            assert found, "feedback predict event not ingested"
+            ev = found[0]
+            assert ev.entity_type == "pio_pr"
+            assert ev.properties.get("engineInstanceId") == row.id
+            assert ev.properties.get("query")["user"] == "u1"
+        finally:
+            srv.shutdown()
+            es.shutdown()
+
+
+class RewritePlugin(EngineServerPlugin):
+    plugin_name = "rewriter"
+    plugin_type = OUTPUT_BLOCKER
+
+    def process(self, info, context):
+        return {"rewritten": True, "orig": to_jsonable(info.prediction)}
+
+
+class TestPlugins:
+    def test_output_blocker_rewrites(self, trained):
+        registry, engine, _, _ = trained
+        config = ServerConfig(ip="127.0.0.1", port=0)
+        srv = PredictionServer(config, registry=registry, engine=engine,
+                               plugins=[RewritePlugin()])
+        srv.start()
+        try:
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200 and body["rewritten"] is True
+            status, body = call(srv.port, "GET", "/plugins.json")
+            assert "rewriter" in body["plugins"]["outputblockers"]
+        finally:
+            srv.shutdown()
+
+
+class TestMicroBatch:
+    def test_concurrent_queries_batched(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, batch_window_ms=50)
+        try:
+            results = {}
+
+            def one(u):
+                results[u] = call(srv.port, "POST", "/queries.json",
+                                  {"user": f"u{u}", "num": 2})
+
+            threads = [threading.Thread(target=one, args=(u,))
+                       for u in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(r[0] == 200 for r in results.values())
+            # batched results must equal the unbatched path
+            direct = call(srv.port, "POST", "/queries.json",
+                          {"user": "u3", "num": 2})
+            assert [s["item"] for s in results[3][1]["itemScores"]] == \
+                   [s["item"] for s in direct[1]["itemScores"]]
+        finally:
+            srv.shutdown()
