@@ -35,3 +35,8 @@ from predictionio_tpu.core.engine import (  # noqa: F401
 from predictionio_tpu.core.workflow import (  # noqa: F401
     CoreWorkflow, register_engine, resolve_engine,
 )
+from predictionio_tpu.core.evaluation import (  # noqa: F401
+    AverageMetric, EngineParamsGenerator, Evaluation, Metric,
+    MetricEvaluator, MetricEvaluatorResult, OptionAverageMetric,
+    StdevMetric, SumMetric, ZeroMetric, run_evaluation,
+)

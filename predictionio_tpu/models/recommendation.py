@@ -24,7 +24,8 @@ import numpy as np
 
 from predictionio_tpu.core import (
     Algorithm, DataSource, Engine, EngineFactory, FirstServing,
-    IdentityPreparator, Params, RuntimeContext, register_engine,
+    IdentityPreparator, OptionAverageMetric, Params, RuntimeContext,
+    register_engine,
 )
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import RatingColumns
@@ -191,6 +192,35 @@ class ALSAlgorithm(Algorithm):
                                        float(s)))
             out.append((i, PredictedResult(tuple(items))))
         return out
+
+
+# -- evaluation metrics (Evaluation.scala of the template) ------------------
+
+class PrecisionAtK(OptionAverageMetric):
+    """Precision@K with a rating threshold: of the top-K recommended
+    items, the fraction the user actually rated >= threshold; None (skip)
+    when the user has no positively-rated items in the test fold
+    (`examples/scala-parallel-recommendation/blacklist-items/src/main/scala/
+    Evaluation.scala`)."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 2.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    def header(self) -> str:
+        return f"Precision@K (k={self.k}, threshold={self.rating_threshold})"
+
+    def calculate_one(self, q: Query, p: PredictedResult,
+                      a: ActualResult) -> Optional[float]:
+        positives = {item for item, r in a.ratings
+                     if r >= self.rating_threshold}
+        if not positives:
+            return None
+        top = [s.item for s in p.itemScores[:self.k]]
+        if not top:
+            return 0.0
+        hits = sum(1 for item in top if item in positives)
+        return hits / min(self.k, len(top))
 
 
 # -- engine -----------------------------------------------------------------

@@ -1,0 +1,216 @@
+"""Evaluation & tuning tests.
+
+Mirrors `core/src/test/scala/.../controller/{MetricTest,
+MetricEvaluatorTest, EvaluationTest}.scala` and `FastEvalEngineTest.scala`
+(prefix memoization counts), plus an end-to-end param sweep on the
+recommendation template with PrecisionAtK.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import (
+    AverageMetric, EngineParams, EngineParamsGenerator, Evaluation,
+    MetricEvaluator, OptionAverageMetric, RuntimeContext, StdevMetric,
+    SumMetric, ZeroMetric, run_evaluation,
+)
+from predictionio_tpu.core.evaluation import _PrefixCache, _eval_with_cache
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.storage.base import EvaluationInstanceStatus
+from predictionio_tpu.models import recommendation as rec
+
+import sample_engine as se
+from test_core_engine import make_engine, ep
+
+
+DATA = [(None, [(1, 2, 3), (2, 4, 6), (3, 6, 9)])]
+
+
+class TestMetrics:
+    def test_average(self):
+        class M(AverageMetric):
+            def calculate_one(self, q, p, a):
+                return p
+
+        assert M().calculate(None, DATA) == 4.0
+
+    def test_option_average_skips_none(self):
+        class M(OptionAverageMetric):
+            def calculate_one(self, q, p, a):
+                return p if q > 1 else None
+
+        assert M().calculate(None, DATA) == 5.0
+
+    def test_sum_stdev_zero(self):
+        class S(SumMetric):
+            def calculate_one(self, q, p, a):
+                return q
+
+        class D(StdevMetric):
+            def calculate_one(self, q, p, a):
+                return q
+
+        assert S().calculate(None, DATA) == 6.0
+        assert abs(D().calculate(None, DATA) - np.std([1, 2, 3])) < 1e-9
+        assert ZeroMetric().calculate(None, DATA) == 0.0
+
+    def test_comparator_direction(self):
+        class Err(AverageMetric):
+            higher_is_better = False
+
+            def calculate_one(self, q, p, a):
+                return p
+
+        m = Err()
+        assert m.compare(1.0, 2.0) > 0  # lower error wins
+        assert AverageMetric.compare(AverageMetric(), 2.0, 1.0) > 0
+
+
+class CountingDS(se.SDataSource):
+    READS = {"n": 0}
+
+    def read_eval(self, ctx):
+        CountingDS.READS["n"] += 1
+        return super().read_eval(ctx)
+
+
+class CountingPrep(se.SPreparator):
+    PREPARES = {"n": 0}
+
+    def prepare(self, ctx, td):
+        CountingPrep.PREPARES["n"] += 1
+        return super().prepare(ctx, td)
+
+
+class CountingAlgo(se.SAlgo):
+    TRAINS = {"n": 0}
+
+    def train(self, ctx, pd):
+        CountingAlgo.TRAINS["n"] += 1
+        return super().train(ctx, pd)
+
+
+@pytest.fixture()
+def counting_engine():
+    from predictionio_tpu.core import Engine
+    CountingDS.READS["n"] = 0
+    CountingPrep.PREPARES["n"] = 0
+    CountingAlgo.TRAINS["n"] = 0
+    return Engine(data_source=CountingDS, preparator=CountingPrep,
+                  algorithms={"algo": CountingAlgo},
+                  serving=se.SServing)
+
+
+class FirstPredMetric(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return p.model.params_value
+
+
+class TestMetricEvaluatorAndFastEval:
+    def test_sweep_picks_best_and_memoizes(self, mem_registry,
+                                           counting_engine):
+        ctx = RuntimeContext(registry=mem_registry)
+        candidates = [
+            ep(("algo", se.SAlgoParams(id=1, value=v)))
+            for v in (3, 9, 5)]
+        evaluator = MetricEvaluator(FirstPredMetric())
+        result = evaluator.evaluate(ctx, counting_engine, candidates)
+        assert result.best_index == 1
+        assert result.best_score.score == 9.0
+        assert [r.score for r in result.all_results] == [3.0, 9.0, 5.0]
+        # FastEval memoization: identical ds/prep params across the three
+        # candidates -> one read_eval, one prepare per fold (2 folds)
+        assert CountingDS.READS["n"] == 1
+        assert CountingPrep.PREPARES["n"] == 2
+        # distinct algo params -> one train per candidate per fold
+        assert CountingAlgo.TRAINS["n"] == 6
+
+    def test_identical_algo_params_share_models(self, mem_registry,
+                                                counting_engine):
+        ctx = RuntimeContext(registry=mem_registry)
+        same = ep(("algo", se.SAlgoParams(id=1, value=7)))
+        cache = _PrefixCache()
+        _eval_with_cache(counting_engine, ctx, same, cache)
+        first = CountingAlgo.TRAINS["n"]
+        _eval_with_cache(counting_engine, ctx, same, cache)
+        assert CountingAlgo.TRAINS["n"] == first  # fully cached
+
+    def test_output_path(self, mem_registry, counting_engine, tmp_path):
+        ctx = RuntimeContext(registry=mem_registry)
+        out = tmp_path / "result.json"
+        evaluator = MetricEvaluator(FirstPredMetric(),
+                                    output_path=str(out))
+        evaluator.evaluate(ctx, counting_engine,
+                           [ep(("algo", se.SAlgoParams(id=1, value=2)))])
+        import json
+        data = json.loads(out.read_text())
+        assert data["bestScore"] == 2.0
+
+
+class TestRunEvaluation:
+    def test_lifecycle_and_results(self, mem_registry, counting_engine):
+        ctx = RuntimeContext(registry=mem_registry)
+        evaluation = Evaluation(
+            engine=counting_engine, metric=FirstPredMetric(),
+            other_metrics=[ZeroMetric()],
+            engine_params_generator=EngineParamsGenerator([
+                ep(("algo", se.SAlgoParams(id=1, value=2))),
+                ep(("algo", se.SAlgoParams(id=1, value=8)))]))
+        row, result = run_evaluation(evaluation, ctx,
+                                     evaluation_class="TestEval")
+        assert row.status == EvaluationInstanceStatus.COMPLETED
+        assert result.best_score.score == 8.0
+        assert "8.0" in row.evaluator_results_json
+        stored = mem_registry.get_meta_data_evaluation_instances()
+        assert stored.get_completed()[0].id == row.id
+        assert "<table>" in row.evaluator_results_html
+
+
+class TestRecommendationEval:
+    def test_precision_at_k_sweep(self, mem_registry):
+        apps = mem_registry.get_meta_data_apps()
+        app_id = apps.insert(App(0, "evalapp"))
+        events = mem_registry.get_events()
+        events.init(app_id)
+        rng = np.random.RandomState(0)
+        for u in range(25):
+            for i in range(20):
+                if rng.rand() > 0.8:
+                    continue
+                r = 5.0 if i % 4 == u % 4 else 1.0
+                events.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": r})), app_id)
+        ctx = RuntimeContext(registry=mem_registry)
+        engine = rec.engine()
+        ds = ("", rec.DataSourceParams(
+            app_name="evalapp",
+            eval_params=rec.EvalParams(k_fold=2, query_num=5)))
+        candidates = [
+            EngineParams(data_source_params=ds, algorithm_params_list=(
+                ("als", rec.ALSAlgorithmParams(rank=r, num_iterations=5,
+                                               lambda_=0.1, seed=1)),))
+            for r in (2, 4)]
+        evaluator = MetricEvaluator(
+            rec.PrecisionAtK(k=5, rating_threshold=4.0))
+        result = evaluator.evaluate(ctx, engine, candidates)
+        assert 0.0 <= result.best_score.score <= 1.0
+        # ~5 of 20 items are block-positives per user but only the test-fold
+        # half counts, so random top-5 precision is ~0.125; the recovered
+        # block structure must clearly beat that
+        assert result.best_score.score > 0.2, result
+
+    def test_precision_metric_semantics(self):
+        m = rec.PrecisionAtK(k=2, rating_threshold=4.0)
+        q = rec.Query(user="u", num=2)
+        p = rec.PredictedResult((rec.ItemScore("a", 1.0),
+                                 rec.ItemScore("b", 0.5)))
+        assert m.calculate_one(q, p, rec.ActualResult(
+            (("a", 5.0), ("c", 5.0)))) == 0.5
+        assert m.calculate_one(q, p, rec.ActualResult(
+            (("a", 1.0),))) is None  # no positives -> skipped
+        assert m.calculate_one(
+            q, rec.PredictedResult(()), rec.ActualResult(
+                (("a", 5.0),))) == 0.0
