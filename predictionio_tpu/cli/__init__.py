@@ -1,0 +1,8 @@
+"""The `pio`-equivalent command line tool.
+
+Parity: `tools/.../console/Console.scala` (scopt grammar + dispatch,
+:134-824) and the command implementations in `tools/.../commands/`.
+Run as `python -m predictionio_tpu.cli <command>`; `ops.py` holds the
+library-level command functions (the `commands/*.scala` analog) so the
+admin API and tests reuse them without a subprocess.
+"""

@@ -1,0 +1,321 @@
+"""Argparse front-end: the `pio` console.
+
+Parity: `tools/.../console/Console.scala:134-824` (grammar + dispatch) and
+`console/Pio.scala` (command wiring). Storage configuration comes from the
+same layered config as everything else (env / pio-env file / zero-config
+sqlite default) via the process-default registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import Optional
+
+from predictionio_tpu.cli import ops
+
+
+def _registry():
+    from predictionio_tpu.data.storage import storage
+    return storage()
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio-tpu",
+        description="predictionio_tpu console (the `pio` analog)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    # app ------------------------------------------------------------------
+    app = sub.add_parser("app", help="manage apps").add_subparsers(
+        dest="app_command", required=True)
+    x = app.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--description")
+    x.add_argument("--access-key", default="")
+    app.add_parser("list")
+    x = app.add_parser("show")
+    x.add_argument("name")
+    x = app.add_parser("delete")
+    x.add_argument("name")
+    x.add_argument("--force", "-f", action="store_true")
+    x = app.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel")
+    x.add_argument("--all", action="store_true")
+    x.add_argument("--force", "-f", action="store_true")
+    x = app.add_parser("channel-new")
+    x.add_argument("app_name")
+    x.add_argument("channel_name")
+    x = app.add_parser("channel-delete")
+    x.add_argument("app_name")
+    x.add_argument("channel_name")
+    x.add_argument("--force", "-f", action="store_true")
+
+    # accesskey ------------------------------------------------------------
+    ak = sub.add_parser("accesskey", help="manage access keys"
+                        ).add_subparsers(dest="ak_command", required=True)
+    x = ak.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("--key", default="")
+    x.add_argument("--events", nargs="*", default=[])
+    x = ak.add_parser("list")
+    x.add_argument("app_name", nargs="?")
+    x = ak.add_parser("delete")
+    x.add_argument("key")
+
+    # build / train / eval / deploy ----------------------------------------
+    x = sub.add_parser("build", help="validate the engine variant")
+    x.add_argument("--engine-json", default="engine.json")
+    x = sub.add_parser("train")
+    x.add_argument("--engine-json", default="engine.json")
+    x.add_argument("--engine-factory")
+    x.add_argument("--batch", default="")
+    x.add_argument("--mesh", help="mesh spec, e.g. data=8 or data=4,model=2")
+    x.add_argument("--skip-sanity-check", action="store_true")
+    x.add_argument("--stop-after-read", action="store_true")
+    x.add_argument("--stop-after-prepare", action="store_true")
+    x = sub.add_parser("eval")
+    x.add_argument("evaluation", help="dotted path to an Evaluation")
+    x.add_argument("params_generator", nargs="?",
+                   help="dotted path to an EngineParamsGenerator")
+    x.add_argument("--output-path")
+    x = sub.add_parser("deploy")
+    x.add_argument("--engine-json", default="engine.json")
+    x.add_argument("--engine-factory")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--feedback", action="store_true")
+    x.add_argument("--event-server-ip", default="localhost")
+    x.add_argument("--event-server-port", type=int, default=7070)
+    x.add_argument("--accesskey")
+    x.add_argument("--batch-window-ms", type=int, default=0)
+    x = sub.add_parser("undeploy")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x = sub.add_parser("batchpredict")
+    x.add_argument("--engine-json", default="engine.json")
+    x.add_argument("--engine-factory")
+    x.add_argument("--input", default="batchpredict-input.json")
+    x.add_argument("--output", default="batchpredict-output.json")
+    x.add_argument("--query-partitions", type=int, default=1024,
+                   help="device batch chunk size")
+
+    # servers --------------------------------------------------------------
+    x = sub.add_parser("eventserver")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=7070)
+    x.add_argument("--stats", action="store_true")
+    x = sub.add_parser("dashboard")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=9000)
+    x = sub.add_parser("adminserver")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=7071)
+
+    # misc -----------------------------------------------------------------
+    sub.add_parser("status")
+    sub.add_parser("version")
+    x = sub.add_parser("import")
+    x.add_argument("--appid", type=int, required=True)
+    x.add_argument("--channel", type=int, default=None)
+    x.add_argument("--input", required=True)
+    x = sub.add_parser("export")
+    x.add_argument("--appid", type=int, required=True)
+    x.add_argument("--channel", type=int, default=None)
+    x.add_argument("--output", required=True)
+    x = sub.add_parser("run", help="run a dotted-path function with storage "
+                                   "configured (console run analog)")
+    x.add_argument("target", help="module.function")
+    return p
+
+
+def _serve_forever(server) -> None:   # pragma: no cover - signal loop
+    stop = {"flag": False}
+
+    def handler(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    try:
+        while not stop["flag"] and server.is_running():
+            time.sleep(0.2)
+    finally:
+        if server.is_running():
+            server.shutdown()
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.command
+    try:
+        if cmd == "app":
+            return _app(args)
+        if cmd == "accesskey":
+            return _accesskey(args)
+        if cmd == "build":
+            variant = ops.load_variant(args.engine_json)
+            from predictionio_tpu.core.workflow import resolve_engine
+            factory = ops.resolve_factory_name(variant, None,
+                                               args.engine_json)
+            engine = resolve_engine(factory)
+            engine.engine_params_from_variant(variant)
+            _emit({"message": "Engine variant is valid",
+                   "engineFactory": factory})
+            return 0
+        if cmd == "train":
+            _emit(ops.train(
+                _registry(), engine_json=args.engine_json,
+                engine_factory=args.engine_factory, batch=args.batch,
+                mesh=args.mesh, skip_sanity_check=args.skip_sanity_check,
+                stop_after_read=args.stop_after_read,
+                stop_after_prepare=args.stop_after_prepare))
+            return 0
+        if cmd == "eval":
+            _emit(ops.run_eval(_registry(), args.evaluation,
+                               args.params_generator, args.output_path))
+            return 0
+        if cmd == "deploy":
+            from predictionio_tpu.serving import (
+                PredictionServer, ServerConfig,
+            )
+            variant = ops.load_variant(args.engine_json)
+            factory = ops.resolve_factory_name(variant, args.engine_factory,
+                                               args.engine_json)
+            config = ServerConfig(
+                ip=args.ip, port=args.port, engine_factory=factory,
+                engine_variant=variant.get("id", "default"),
+                feedback=args.feedback,
+                event_server_ip=args.event_server_ip,
+                event_server_port=args.event_server_port,
+                access_key=args.accesskey,
+                batch_window_ms=args.batch_window_ms)
+            server = PredictionServer(config, registry=_registry())
+            port = server.start()
+            print(f"Engine server started on {args.ip}:{port}", flush=True)
+            _serve_forever(server)
+            return 0
+        if cmd == "undeploy":
+            ok = ops.undeploy(args.ip, args.port)
+            print("Undeployed" if ok else "No server responded")
+            return 0 if ok else 1
+        if cmd == "batchpredict":
+            _emit(ops.batchpredict(
+                _registry(), engine_json=args.engine_json,
+                engine_factory=args.engine_factory,
+                input_path=args.input, output_path=args.output,
+                chunk_size=args.query_partitions))
+            return 0
+        if cmd == "eventserver":
+            from predictionio_tpu.data.eventserver import (
+                EventServer, EventServerConfig,
+            )
+            server = EventServer(
+                EventServerConfig(ip=args.ip, port=args.port,
+                                  stats=args.stats), _registry())
+            port = server.start()
+            print(f"Event server started on {args.ip}:{port}", flush=True)
+            _serve_forever(server)
+            return 0
+        if cmd == "dashboard":
+            from predictionio_tpu.tools.dashboard import (
+                Dashboard, DashboardConfig,
+            )
+            server = Dashboard(DashboardConfig(ip=args.ip, port=args.port),
+                               _registry())
+            port = server.start()
+            print(f"Dashboard started on {args.ip}:{port}", flush=True)
+            _serve_forever(server)
+            return 0
+        if cmd == "adminserver":
+            from predictionio_tpu.tools.admin import AdminConfig, AdminServer
+            server = AdminServer(AdminConfig(ip=args.ip, port=args.port),
+                                 _registry())
+            port = server.start()
+            print(f"Admin server started on {args.ip}:{port}", flush=True)
+            _serve_forever(server)
+            return 0
+        if cmd == "status":
+            _emit(ops.status(_registry()))
+            return 0
+        if cmd == "version":
+            import predictionio_tpu
+            print(predictionio_tpu.__version__)
+            return 0
+        if cmd == "import":
+            n = ops.import_events(_registry(), app_id=args.appid,
+                                  channel_id=args.channel,
+                                  input_path=args.input)
+            _emit({"imported": n})
+            return 0
+        if cmd == "export":
+            n = ops.export_events(_registry(), app_id=args.appid,
+                                  channel_id=args.channel,
+                                  output_path=args.output)
+            _emit({"exported": n})
+            return 0
+        if cmd == "run":
+            import importlib
+            module_name, _, attr = args.target.rpartition(".")
+            fn = getattr(importlib.import_module(module_name), attr)
+            result = fn()
+            if result is not None:
+                _emit(result)
+            return 0
+    except (ValueError, OSError) as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    print(f"Unknown command {cmd}", file=sys.stderr)
+    return 1
+
+
+def _app(args) -> int:
+    registry = _registry()
+    c = args.app_command
+    if c == "new":
+        _emit(ops.app_new(registry, args.name, description=args.description,
+                          access_key=args.access_key))
+    elif c == "list":
+        _emit(ops.app_list(registry))
+    elif c == "show":
+        _emit(ops.app_show(registry, args.name))
+    elif c == "delete":
+        ops.app_delete(registry, args.name, force=args.force)
+        _emit({"message": f"App {args.name} deleted"})
+    elif c == "data-delete":
+        ops.app_data_delete(registry, args.name, channel=args.channel,
+                            all_channels=args.all, force=args.force)
+        _emit({"message": f"App {args.name} data deleted"})
+    elif c == "channel-new":
+        _emit(ops.channel_new(registry, args.app_name, args.channel_name))
+    elif c == "channel-delete":
+        ops.channel_delete(registry, args.app_name, args.channel_name,
+                           force=args.force)
+        _emit({"message": f"Channel {args.channel_name} deleted"})
+    return 0
+
+
+def _accesskey(args) -> int:
+    registry = _registry()
+    c = args.ak_command
+    if c == "new":
+        _emit(ops.accesskey_new(registry, args.app_name, key=args.key,
+                                events=args.events))
+    elif c == "list":
+        _emit(ops.accesskey_list(registry, args.app_name))
+    elif c == "delete":
+        ops.accesskey_delete(registry, args.key)
+        _emit({"message": "Deleted"})
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    sys.exit(main())

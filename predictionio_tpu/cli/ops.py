@@ -1,0 +1,354 @@
+"""Command implementations shared by the CLI, admin API, and tests.
+
+Parity: `tools/.../commands/{App,AccessKey,Engine,Management,Export,
+Import}.scala`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event, format_time
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+
+
+# ---------------------------------------------------------------------------
+# app (commands/App.scala:31-360)
+# ---------------------------------------------------------------------------
+
+def app_new(registry, name: str, *, description: Optional[str] = None,
+            access_key: str = "") -> Dict[str, Any]:
+    apps = registry.get_meta_data_apps()
+    if apps.get_by_name(name) is not None:
+        raise ValueError(f"App {name} already exists. Aborting.")
+    app_id = apps.insert(App(0, name, description))
+    registry.get_events().init(app_id)
+    key = registry.get_meta_data_access_keys().insert(
+        AccessKey(access_key, app_id, ()))
+    return {"name": name, "id": app_id, "accessKey": key}
+
+
+def _require_app(registry, name: str) -> App:
+    app = registry.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise ValueError(f"App {name} does not exist. Aborting.")
+    return app
+
+
+def app_list(registry) -> List[Dict[str, Any]]:
+    out = []
+    for app in sorted(registry.get_meta_data_apps().get_all(),
+                      key=lambda a: a.name):
+        keys = registry.get_meta_data_access_keys().get_by_appid(app.id)
+        out.append({"name": app.name, "id": app.id,
+                    "accessKeys": [k.key for k in keys]})
+    return out
+
+
+def app_show(registry, name: str) -> Dict[str, Any]:
+    app = _require_app(registry, name)
+    keys = registry.get_meta_data_access_keys().get_by_appid(app.id)
+    channels = registry.get_meta_data_channels().get_by_appid(app.id)
+    return {
+        "name": app.name, "id": app.id, "description": app.description,
+        "accessKeys": [{"key": k.key,
+                        "events": list(k.events) or "(all)"} for k in keys],
+        "channels": [{"id": c.id, "name": c.name} for c in channels],
+    }
+
+
+def app_delete(registry, name: str, *, force: bool = False) -> None:
+    app = _require_app(registry, name)
+    if not force:
+        raise ValueError("Pass force=True (CLI: --force) to delete")
+    events = registry.get_events()
+    for ch in registry.get_meta_data_channels().get_by_appid(app.id):
+        events.remove(app.id, ch.id)
+        registry.get_meta_data_channels().delete(ch.id)
+    events.remove(app.id)
+    for k in registry.get_meta_data_access_keys().get_by_appid(app.id):
+        registry.get_meta_data_access_keys().delete(k.key)
+    registry.get_meta_data_apps().delete(app.id)
+
+
+def app_data_delete(registry, name: str, *,
+                    channel: Optional[str] = None,
+                    all_channels: bool = False,
+                    force: bool = False) -> None:
+    app = _require_app(registry, name)
+    if not force:
+        raise ValueError("Pass force=True (CLI: --force) to delete data")
+    events = registry.get_events()
+    channels = registry.get_meta_data_channels().get_by_appid(app.id)
+    if channel is not None:
+        match = [c for c in channels if c.name == channel]
+        if not match:
+            raise ValueError(f"Channel {channel} does not exist. Aborting.")
+        events.remove(app.id, match[0].id)
+        events.init(app.id, match[0].id)
+        return
+    events.remove(app.id)
+    events.init(app.id)
+    if all_channels:
+        for c in channels:
+            events.remove(app.id, c.id)
+            events.init(app.id, c.id)
+
+
+def channel_new(registry, app_name: str, channel_name: str) -> Dict[str, Any]:
+    app = _require_app(registry, app_name)
+    channels = registry.get_meta_data_channels()
+    if any(c.name == channel_name for c in channels.get_by_appid(app.id)):
+        raise ValueError(f"Channel {channel_name} already exists. Aborting.")
+    channel_id = channels.insert(Channel(0, channel_name, app.id))
+    registry.get_events().init(app.id, channel_id)
+    return {"app": app_name, "channel": channel_name, "id": channel_id}
+
+
+def channel_delete(registry, app_name: str, channel_name: str, *,
+                   force: bool = False) -> None:
+    app = _require_app(registry, app_name)
+    if not force:
+        raise ValueError("Pass force=True (CLI: --force) to delete")
+    channels = registry.get_meta_data_channels()
+    match = [c for c in channels.get_by_appid(app.id)
+             if c.name == channel_name]
+    if not match:
+        raise ValueError(f"Channel {channel_name} does not exist. Aborting.")
+    registry.get_events().remove(app.id, match[0].id)
+    channels.delete(match[0].id)
+
+
+# ---------------------------------------------------------------------------
+# accesskey (commands/AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+def accesskey_new(registry, app_name: str, *, key: str = "",
+                  events: Sequence[str] = ()) -> Dict[str, Any]:
+    app = _require_app(registry, app_name)
+    new_key = registry.get_meta_data_access_keys().insert(
+        AccessKey(key, app.id, tuple(events)))
+    return {"accessKey": new_key, "app": app_name, "events": list(events)}
+
+
+def accesskey_list(registry, app_name: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+    keys_dao = registry.get_meta_data_access_keys()
+    if app_name is not None:
+        app = _require_app(registry, app_name)
+        keys = keys_dao.get_by_appid(app.id)
+    else:
+        keys = keys_dao.get_all()
+    return [{"accessKey": k.key, "appid": k.appid,
+             "events": list(k.events)} for k in keys]
+
+
+def accesskey_delete(registry, key: str) -> None:
+    dao = registry.get_meta_data_access_keys()
+    if dao.get(key) is None:
+        raise ValueError(f"Access key {key} does not exist. Aborting.")
+    dao.delete(key)
+
+
+# ---------------------------------------------------------------------------
+# train / eval / deploy plumbing (commands/Engine.scala)
+# ---------------------------------------------------------------------------
+
+def load_variant(path: str) -> Dict[str, Any]:
+    p = Path(path)
+    if not p.is_file():
+        raise ValueError(f"Engine variant file {path} not found")
+    return json.loads(p.read_text())
+
+
+def resolve_factory_name(variant: Dict[str, Any],
+                         engine_factory: Optional[str],
+                         engine_json: str) -> str:
+    factory = engine_factory or variant.get("engineFactory")
+    if not factory:
+        raise ValueError(
+            f"No engineFactory in {engine_json} and none given "
+            "(--engine-factory)")
+    return factory
+
+
+def train(registry, *, engine_json: str = "engine.json",
+          engine_factory: Optional[str] = None,
+          batch: str = "", mesh: Optional[str] = None,
+          skip_sanity_check: bool = False,
+          stop_after_read: bool = False,
+          stop_after_prepare: bool = False) -> Dict[str, Any]:
+    """pio train (commands/Engine.scala:177-188 -> CreateWorkflow)."""
+    from predictionio_tpu.core import RuntimeContext, WorkflowParams
+    from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
+
+    variant = load_variant(engine_json)
+    factory = resolve_factory_name(variant, engine_factory, engine_json)
+    engine = resolve_engine(factory)
+    engine_params = engine.engine_params_from_variant(variant)
+    runtime_conf = {}
+    if mesh:
+        runtime_conf["mesh"] = mesh
+    ctx = RuntimeContext(
+        registry=registry,
+        workflow_params=WorkflowParams(
+            batch=batch, skip_sanity_check=skip_sanity_check,
+            stop_after_read=stop_after_read,
+            stop_after_prepare=stop_after_prepare,
+            runtime_conf=runtime_conf))
+    row = CoreWorkflow.run_train(
+        engine, engine_params, ctx,
+        engine_factory=factory,
+        engine_variant=variant.get("id", "default"))
+    return {"engineInstanceId": row.id, "status": row.status,
+            "startTime": format_time(row.start_time),
+            "endTime": format_time(row.end_time)}
+
+
+def run_eval(registry, evaluation_path: str,
+             params_generator_path: Optional[str] = None,
+             output_path: Optional[str] = None) -> Dict[str, Any]:
+    """pio eval <Evaluation> [<EngineParamsGenerator>]
+    (Console.scala eval command)."""
+    import importlib
+
+    from predictionio_tpu.core import (
+        MetricEvaluator, RuntimeContext, run_evaluation,
+    )
+
+    def resolve(dotted: str):
+        module_name, _, attr = dotted.rpartition(".")
+        obj = getattr(importlib.import_module(module_name), attr)
+        return obj() if callable(obj) and not hasattr(obj, "engine") else obj
+
+    evaluation = resolve(evaluation_path)
+    engine_params_list = None
+    if params_generator_path:
+        gen = resolve(params_generator_path)
+        engine_params_list = gen.engine_params_list
+    ctx = RuntimeContext(registry=registry)
+    evaluator = MetricEvaluator(evaluation.metric, evaluation.other_metrics,
+                                output_path=output_path)
+    row, result = run_evaluation(
+        evaluation, ctx, evaluation_class=evaluation_path,
+        engine_params_list=engine_params_list, evaluator=evaluator)
+    return {"evaluationInstanceId": row.id, "result": result.one_liner(),
+            "bestScore": result.best_score.score}
+
+
+def batchpredict(registry, *, engine_json: str = "engine.json",
+                 engine_factory: Optional[str] = None,
+                 input_path: str = "batchpredict-input.json",
+                 output_path: str = "batchpredict-output.json",
+                 chunk_size: int = 1024) -> Dict[str, Any]:
+    """pio batchpredict (commands/Engine.scala:279-314)."""
+    from predictionio_tpu.core import RuntimeContext
+    from predictionio_tpu.core.batchpredict import run_batch_predict
+    from predictionio_tpu.core.workflow import resolve_engine
+
+    variant = load_variant(engine_json)
+    factory = resolve_factory_name(variant, engine_factory, engine_json)
+    engine = resolve_engine(factory)
+    ctx = RuntimeContext(registry=registry)
+    instance = _latest_completed(registry, variant.get("id", "default"))
+    n = run_batch_predict(engine, instance, ctx, input_path=input_path,
+                          output_path=output_path, chunk_size=chunk_size)
+    return {"engineInstanceId": instance.id, "predictions": n,
+            "output": output_path}
+
+
+def _latest_completed(registry, variant_id: str):
+    instances = registry.get_meta_data_engine_instances()
+    inst = instances.get_latest_completed("default", "default", variant_id)
+    if inst is None:
+        raise ValueError(
+            "No valid engine instance found for this engine. Try running "
+            "'train' before 'deploy' (commands/Engine.scala:235-236)")
+    return inst
+
+
+def undeploy(ip: str = "127.0.0.1", port: int = 8000) -> bool:
+    """POST /stop to a running prediction server (Console undeploy)."""
+    import urllib.request
+    try:
+        req = urllib.request.Request(f"http://{ip}:{port}/stop",
+                                     data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status == 200
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# status (commands/Management.scala:99-181)
+# ---------------------------------------------------------------------------
+
+def status(registry) -> Dict[str, Any]:
+    import jax
+
+    import predictionio_tpu
+
+    info: Dict[str, Any] = {
+        "version": predictionio_tpu.__version__,
+        "storageSources": {
+            name: cfg.get("TYPE") for name, cfg in registry.sources.items()},
+        "repositories": {
+            repo: cfg.get("SOURCE")
+            for repo, cfg in registry.repositories.items()},
+    }
+    try:
+        registry.verify_all_data_objects()
+        info["storage"] = "ok"
+    except Exception as e:
+        info["storage"] = f"error: {e}"
+    try:
+        devices = jax.devices()
+        info["devices"] = [str(d) for d in devices]
+        info["platform"] = devices[0].platform if devices else "none"
+    except Exception as e:  # pragma: no cover - env dependent
+        info["devices"] = []
+        info["platform"] = f"error: {e}"
+    info["status"] = ("(sleeping)" if info["storage"] == "ok"
+                      else "storage check failed")
+    return info
+
+
+# ---------------------------------------------------------------------------
+# import / export (tools/.../{imprt,export})
+# ---------------------------------------------------------------------------
+
+def import_events(registry, *, app_id: int, input_path: str,
+                  channel_id: Optional[int] = None) -> int:
+    """JSON-lines file -> event store (imprt/FileToEvents.scala:40-106)."""
+    store = registry.get_events()
+    store.init(app_id, channel_id)
+    n = 0
+    batch: List[Event] = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            batch.append(Event.from_api_json(json.loads(line)))
+            if len(batch) >= 500:
+                store.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        store.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n
+
+
+def export_events(registry, *, app_id: int, output_path: str,
+                  channel_id: Optional[int] = None) -> int:
+    """Event store -> JSON-lines file (export/EventsToFile.scala:40-108)."""
+    n = 0
+    with open(output_path, "w") as f:
+        for e in registry.get_events().find(app_id, channel_id):
+            f.write(json.dumps(e.to_api_json()) + "\n")
+            n += 1
+    return n

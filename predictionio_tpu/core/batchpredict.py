@@ -1,0 +1,65 @@
+"""Offline bulk inference.
+
+Parity: `core/.../workflow/BatchPredict.scala:145-229` — read one query
+per line (JSON), run the supplement -> predict-all-algos -> serve chain,
+write one JSON prediction per line, preserving input order.
+
+TPU-first difference: the reference maps queries one at a time inside an
+RDD; here queries are chunked into device batches through the algorithms'
+`batch_predict` (one jit'd program per chunk shape).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Iterator, List, Optional
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.params import extract_params
+from predictionio_tpu.core.runtime import RuntimeContext
+from predictionio_tpu.core.workflow import CoreWorkflow
+from predictionio_tpu.serving.server import _Deployment, to_jsonable
+
+
+def batch_predict_lines(engine: Engine, instance, ctx: RuntimeContext,
+                        lines: Iterable[str], *,
+                        chunk_size: int = 1024) -> Iterator[str]:
+    """Yield one JSON result line per input query line, in order."""
+    algos, models, serving = CoreWorkflow.prepare_deploy(engine, instance, ctx)
+    # the same serve chain the prediction server runs, one chunk at a time
+    dep = _Deployment(engine, instance, algos, models, serving)
+
+    def flush(payloads: List[dict]) -> Iterator[str]:
+        queries = [extract_params(dep.query_class, p)
+                   if dep.query_class is not None else p
+                   for p in payloads]
+        predictions = dep.predict_batch(queries)
+        for payload, prediction in zip(payloads, predictions):
+            yield json.dumps({"query": payload,
+                              "prediction": to_jsonable(prediction)})
+
+    chunk: List[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        chunk.append(json.loads(line))
+        if len(chunk) >= chunk_size:
+            yield from flush(chunk)
+            chunk = []
+    if chunk:
+        yield from flush(chunk)
+
+
+def run_batch_predict(engine: Engine, instance, ctx: RuntimeContext, *,
+                      input_path: str, output_path: str,
+                      chunk_size: int = 1024) -> int:
+    """File-to-file driver (BatchPredict.scala main); returns the number
+    of predictions written."""
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        for out_line in batch_predict_lines(engine, instance, ctx,
+                                            fin, chunk_size=chunk_size):
+            fout.write(out_line + "\n")
+            n += 1
+    return n

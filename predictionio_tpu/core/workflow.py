@@ -39,6 +39,16 @@ def resolve_engine(factory_name: str) -> Engine:
     """Resolve an engine factory by registered short name or dotted path
     'package.module.FactoryClass' (WorkflowUtils.getEngine analog)."""
     target = _ENGINE_FACTORIES.get(factory_name)
+    if target is None and "." not in factory_name:
+        # short names self-register on import: try the bundled templates
+        mod_name = f"predictionio_tpu.models.{factory_name}"
+        try:
+            importlib.import_module(mod_name)
+            target = _ENGINE_FACTORIES.get(factory_name)
+        except ModuleNotFoundError as e:
+            if e.name != mod_name:
+                raise   # a real dependency failure inside the template
+
     if target is None:
         module_name, _, attr = factory_name.rpartition(".")
         if not module_name:

@@ -1,0 +1,7 @@
+"""Operator tools: dashboard and admin REST API.
+
+The analog of the reference's `tools/` module beyond the CLI itself
+(SURVEY.md §2.4): `dashboard.py` ≙ `tools/.../dashboard/Dashboard.scala`
+(evaluation-history UI on :9000), `admin.py` ≙
+`tools/.../admin/AdminAPI.scala` (app CRUD REST on :7071).
+"""

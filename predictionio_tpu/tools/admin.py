@@ -1,0 +1,80 @@
+"""Admin REST API.
+
+Parity: `tools/.../admin/AdminAPI.scala:77-95` + `admin/CommandClient.scala`
+(experimental app CRUD over REST on :7071):
+  GET  /                      -> server status
+  GET  /cmd/app               -> list apps (with access keys)
+  POST /cmd/app               -> create app {"name": ...}
+  DELETE /cmd/app/<name>      -> delete app and its data
+  DELETE /cmd/app/<name>/data -> wipe app event data
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.core import RuntimeContext
+from predictionio_tpu.utils.http import HTTPServerBase, Request, Response
+
+
+@dataclass
+class AdminConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7071
+
+
+class AdminServer(HTTPServerBase):
+    def __init__(self, config: AdminConfig, registry=None):
+        super().__init__(host=config.ip, port=config.port)
+        self.ctx = RuntimeContext(registry=registry)
+        self._routes()
+
+    def _routes(self):
+        r = self.router
+        from predictionio_tpu.cli import ops
+
+        @r.get("/")
+        def index(req: Request) -> Response:
+            return Response.json({"status": "alive"})
+
+        @r.get("/cmd/app")
+        def list_apps(req: Request) -> Response:
+            reg = self.ctx.registry
+            out = []
+            for app in reg.get_meta_data_apps().get_all():
+                keys = reg.get_meta_data_access_keys().get_by_appid(app.id)
+                out.append({"name": app.name, "id": app.id,
+                            "description": app.description,
+                            "accessKeys": [k.key for k in keys]})
+            return Response.json(out)
+
+        @r.post("/cmd/app")
+        def new_app(req: Request) -> Response:
+            payload = req.json()
+            name = payload.get("name")
+            if not name:
+                return Response.json({"message": "name required"}, 400)
+            try:
+                info = ops.app_new(self.ctx.registry, name,
+                                   description=payload.get("description"))
+            except ValueError as e:
+                return Response.json({"message": str(e)}, 409)
+            return Response.json(info, 201)
+
+        @r.delete("/cmd/app/<name>")
+        def delete_app(req: Request) -> Response:
+            try:
+                ops.app_delete(self.ctx.registry, req.params["name"],
+                               force=True)
+            except ValueError as e:
+                return Response.json({"message": str(e)}, 404)
+            return Response.json({"message": "deleted"})
+
+        @r.delete("/cmd/app/<name>/data")
+        def delete_data(req: Request) -> Response:
+            try:
+                ops.app_data_delete(self.ctx.registry, req.params["name"],
+                                    force=True)
+            except ValueError as e:
+                return Response.json({"message": str(e)}, 404)
+            return Response.json({"message": "data deleted"})

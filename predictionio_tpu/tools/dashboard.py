@@ -1,0 +1,84 @@
+"""Evaluation dashboard.
+
+Parity: `tools/.../dashboard/Dashboard.scala:60-160` + Twirl templates —
+an HTML page listing completed evaluation instances (most recent first)
+with their params and results, plus per-instance detail pages; CORS
+headers for embedding (`dashboard/CorsSupport.scala`).
+"""
+
+from __future__ import annotations
+
+import html
+from dataclasses import dataclass
+
+from predictionio_tpu.core import RuntimeContext
+from predictionio_tpu.data.event import format_time
+from predictionio_tpu.utils.http import (
+    HTTPServerBase, Request, Response,
+)
+
+CORS_HEADERS = {"Access-Control-Allow-Origin": "*",
+                "Access-Control-Allow-Methods": "GET"}
+
+
+@dataclass
+class DashboardConfig:
+    ip: str = "0.0.0.0"
+    port: int = 9000
+
+
+class Dashboard(HTTPServerBase):
+    def __init__(self, config: DashboardConfig, registry=None):
+        super().__init__(host=config.ip, port=config.port)
+        self.ctx = RuntimeContext(registry=registry)
+        self._routes()
+
+    def _instances(self):
+        return self.ctx.registry.get_meta_data_evaluation_instances()
+
+    def _routes(self):
+        r = self.router
+
+        @r.get("/")
+        def index(req: Request) -> Response:
+            rows = []
+            for i in self._instances().get_completed():
+                iid = html.escape(i.id, quote=True)
+                rows.append(
+                    f"<tr><td><a href='/engine_instances/{iid}'>{iid}</a>"
+                    f"</td><td>{format_time(i.start_time)}</td>"
+                    f"<td>{html.escape(i.evaluation_class)}</td>"
+                    f"<td>{html.escape(i.evaluator_results)}</td></tr>")
+            body = (
+                "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
+                "<body><h1>Completed evaluations</h1>"
+                "<table border=1><tr><th>Instance</th><th>Started</th>"
+                "<th>Evaluation</th><th>Result</th></tr>"
+                + "".join(rows) + "</table></body></html>")
+            return Response(status=200, body=body, content_type="text/html",
+                            headers=CORS_HEADERS)
+
+        # the .json route must be registered first: routes match in order
+        # and the plain <iid> capture would swallow "<id>.json"
+        @r.get("/engine_instances/<iid>.json")
+        def detail_json(req: Request) -> Response:
+            inst = self._instances().get(req.params["iid"])
+            if inst is None:
+                return Response.json({"message": "Not Found"}, 404)
+            return Response(status=200, body=inst.evaluator_results_json,
+                            content_type="application/json",
+                            headers=CORS_HEADERS)
+
+        @r.get("/engine_instances/<iid>")
+        def detail(req: Request) -> Response:
+            inst = self._instances().get(req.params["iid"])
+            if inst is None:
+                return Response.json({"message": "Not Found"}, 404)
+            body = (
+                f"<html><body><h1>Evaluation {html.escape(inst.id)}</h1>"
+                f"<p>{html.escape(inst.evaluation_class)} — "
+                f"{html.escape(inst.evaluator_results)}</p>"
+                f"{inst.evaluator_results_html}"  # framework-generated table
+                "</body></html>")
+            return Response(status=200, body=body, content_type="text/html",
+                            headers=CORS_HEADERS)

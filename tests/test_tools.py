@@ -1,0 +1,85 @@
+"""Dashboard + admin API tests (tools/ plane)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core import (
+    EngineParamsGenerator, Evaluation, RuntimeContext, run_evaluation,
+)
+from predictionio_tpu.tools.admin import AdminConfig, AdminServer
+from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+
+import sample_engine as se
+from test_core_engine import make_engine, ep
+from test_evaluation import FirstPredMetric
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, mem_registry):
+        ctx = RuntimeContext(registry=mem_registry)
+        evaluation = Evaluation(
+            engine=make_engine(), metric=FirstPredMetric(),
+            engine_params_generator=EngineParamsGenerator(
+                [ep(("algo", se.SAlgoParams(id=1, value=5)))]))
+        row, _ = run_evaluation(evaluation, ctx, evaluation_class="E2E")
+        srv = Dashboard(DashboardConfig(ip="127.0.0.1", port=0),
+                        mem_registry)
+        srv.start()
+        try:
+            status, html = call(srv.port, "GET", "/")
+            assert status == 200 and row.id in html and "E2E" in html
+            status, html = call(srv.port, "GET",
+                                f"/engine_instances/{row.id}")
+            assert status == 200 and "<table>" in html
+            status, body = call(srv.port, "GET",
+                                f"/engine_instances/{row.id}.json")
+            assert status == 200 and body["bestScore"] == 5.0
+            status, _ = call(srv.port, "GET", "/engine_instances/zzz")
+            assert status == 404
+        finally:
+            srv.shutdown()
+
+
+class TestAdmin:
+    def test_app_crud_over_rest(self, mem_registry):
+        srv = AdminServer(AdminConfig(ip="127.0.0.1", port=0), mem_registry)
+        srv.start()
+        try:
+            status, body = call(srv.port, "GET", "/")
+            assert status == 200 and body["status"] == "alive"
+            status, body = call(srv.port, "POST", "/cmd/app",
+                                {"name": "adminapp"})
+            assert status == 201 and body["accessKey"]
+            status, body = call(srv.port, "POST", "/cmd/app",
+                                {"name": "adminapp"})
+            assert status == 409
+            status, body = call(srv.port, "GET", "/cmd/app")
+            assert status == 200 and body[0]["name"] == "adminapp"
+            status, _ = call(srv.port, "DELETE", "/cmd/app/adminapp/data")
+            assert status == 200
+            status, _ = call(srv.port, "DELETE", "/cmd/app/adminapp")
+            assert status == 200
+            status, body = call(srv.port, "GET", "/cmd/app")
+            assert body == []
+            status, _ = call(srv.port, "DELETE", "/cmd/app/ghost")
+            assert status == 404
+        finally:
+            srv.shutdown()
