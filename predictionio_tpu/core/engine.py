@@ -70,6 +70,7 @@ class Engine:
     def train(self, ctx: RuntimeContext,
               engine_params: EngineParams) -> List[Any]:
         ds, prep, algos, _ = self.make_components(engine_params)
+        bind_serving_context(algos, ctx)
         wp = ctx.workflow_params
         td = ds.read_training(ctx)
         if not wp.skip_sanity_check:
@@ -94,6 +95,7 @@ class Engine:
              ) -> List[Tuple[Any, Sequence[Tuple[Any, Any, Any]]]]:
         """Returns [(evalInfo, [(query, prediction, actual)])] per fold."""
         ds, prep, algos, serving = self.make_components(engine_params)
+        bind_serving_context(algos, ctx)
         folds = ds.read_eval(ctx)
         out = []
         for td, eval_info, qa_pairs in folds:
@@ -167,6 +169,17 @@ class Engine:
             serving_params=one(self.serving_classes, "Serving",
                                variant.get("serving")),
         )
+
+
+def bind_serving_context(algos, ctx: RuntimeContext) -> None:
+    """Give algorithms that read the event store at serve time (e-comm
+    constraint events, ECommAlgorithm.scala:331-430) the live context.
+    Called on every path that runs predict: train (direct use), eval, and
+    prepare_deploy."""
+    for algo in algos:
+        hook = getattr(algo, "with_serving_context", None)
+        if callable(hook):
+            hook(ctx)
 
 
 class SimpleEngine(Engine):

@@ -250,7 +250,9 @@ class _PrefixCache:
 def _eval_with_cache(engine: Engine, ctx: RuntimeContext,
                      engine_params: EngineParams,
                      cache: _PrefixCache) -> EvalDataSet:
+    from predictionio_tpu.core.engine import bind_serving_context
     ds, prep, algos, serving = engine.make_components(engine_params)
+    bind_serving_context(algos, ctx)
     ds_key = _PrefixCache.key(engine_params.data_source_params)
     if ds_key not in cache.folds:
         cache.folds[ds_key] = ds.read_eval(ctx)

@@ -128,7 +128,9 @@ class CoreWorkflow:
         CreateServer.createServerActorWithEngine:186-244)."""
         if engine_params is None:
             engine_params = engine_params_from_instance(engine, instance)
+        from predictionio_tpu.core.engine import bind_serving_context
         ds, prep, algos, serving = engine.make_components(engine_params)
+        bind_serving_context(algos, ctx)
         blob_row = ctx.registry.get_model_data_models().get(instance.id)
         if blob_row is None:
             raise ValueError(f"No model blob for instance {instance.id}")

@@ -1,0 +1,40 @@
+"""Shared serving helpers for the recommender templates."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.ingest import BiMap
+from predictionio_tpu.ops.topk import build_mask
+
+
+def resolve_item_mask(items: BiMap,
+                      item_categories: Optional[Dict[str, List[str]]] = None,
+                      *,
+                      categories: Optional[Sequence[str]] = None,
+                      white_list: Optional[Sequence[str]] = None,
+                      black_list: Sequence[str] = (),
+                      extra_blacklist_ix: Sequence[int] = ()) -> np.ndarray:
+    """One [1, n_items] allowed-mask from the standard template filters:
+    whiteList / blackList (item ids; unknown ids ignored), extra blacklist
+    indexes (seen/unavailable/query items), and a categories any-of filter
+    over per-item category lists. Used by the recommendation,
+    similarproduct, e-commerce, and two-tower templates."""
+    n = len(items)
+    white = None
+    if white_list is not None:
+        white = [ix for it in white_list if (ix := items.get(it)) is not None]
+    black = [ix for it in black_list if (ix := items.get(it)) is not None]
+    black += list(extra_blacklist_ix)
+    mask = build_mask(n, blacklist_ix=black, whitelist_ix=white).copy()
+    if categories is not None:
+        want = set(categories)
+        cat_ok = np.zeros(n, bool)
+        for item_id, cats in (item_categories or {}).items():
+            ix = items.get(item_id)
+            if ix is not None and want & set(cats):
+                cat_ok[ix] = True
+        mask &= cat_ok[None, :]
+    return mask

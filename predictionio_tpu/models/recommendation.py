@@ -30,7 +30,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import RatingColumns
 from predictionio_tpu.ops import als
-from predictionio_tpu.ops.topk import NEG_INF, build_mask, topk_scores
+from predictionio_tpu.ops.topk import NEG_INF, topk_scores
 
 
 # -- queries and results (wire-format parity) -------------------------------
@@ -171,16 +171,11 @@ class ALSAlgorithm(Algorithm):
         n_items = model.item_factors.shape[0]
         k = max(min(q.num, n_items) for _, q, _ in live)
         vecs = model.user_factors[np.array([u for _, _, u in live])]
-        mask = np.ones((len(live), n_items), bool)
-        for row, (_, q, _) in enumerate(live):
-            mask[row] = build_mask(
-                n_items,
-                blacklist_ix=[ix for it in (q.blackList or ())
-                              if (ix := model.items.get(it)) is not None],
-                whitelist_ix=(
-                    None if q.whiteList is None else
-                    [ix for it in q.whiteList
-                     if (ix := model.items.get(it)) is not None]))[0]
+        from predictionio_tpu.models.common import resolve_item_mask
+        mask = np.concatenate(
+            [resolve_item_mask(model.items, white_list=q.whiteList,
+                               black_list=q.blackList or ())
+             for _, q, _ in live], axis=0)
         scores, ixs = topk_scores(vecs, model.item_factors, mask, k=k)
         scores, ixs = np.asarray(scores), np.asarray(ixs)
         for row, (i, q, _) in enumerate(live):

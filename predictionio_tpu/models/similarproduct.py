@@ -1,0 +1,283 @@
+"""Similar-product template: implicit ALS + cooccurrence + like/dislike,
+demonstrating a multi-algorithm engine.
+
+Parity target: `examples/scala-parallel-similarproduct/
+multi-events-multi-algos/`
+  - DataSource reads `$set` item events (with `categories`) and `view` +
+    `like`/`dislike` events (`DataSource.scala`)
+  - ALSAlgorithm: MLlib implicit ALS on views (`ALSAlgorithm.scala:120`),
+    query = set of liked items -> cosine-similar items, with category /
+    whiteList / blackList filters and query items excluded
+  - LikeAlgorithm: like=+1 / dislike=-1 implicit ALS
+    (`LikeAlgorithm.scala:37-101`)
+  - CooccurrenceAlgorithm: item-item cooccurrence counts
+    (`CooccurrenceAlgorithm.scala:47-110`)
+  - Serving averages scores per item across algorithms (`Serving.scala`)
+  - wire: query `{"items": ["i1"], "num": 4}` ->
+    `{"itemScores": [{"item": ..., "score": ...}]}`
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm, DataSource, Engine, EngineFactory, IdentityPreparator,
+    Params, RuntimeContext, Serving, register_engine,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.ingest import BiMap, RatingColumns
+from predictionio_tpu.ops import als
+from predictionio_tpu.ops.cooccur import (
+    CooccurrenceModel, cooccurrence_matrix, top_cooccurrences,
+)
+from predictionio_tpu.ops.topk import NEG_INF, topk_similar
+
+
+@dataclass(frozen=True)
+class Query(Params):
+    items: Sequence[str] = ()
+    num: int = 10
+    categories: Optional[Sequence[str]] = None
+    whiteList: Optional[Sequence[str]] = None
+    blackList: Optional[Sequence[str]] = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: Sequence[ItemScore] = ()
+
+
+@dataclass
+class TrainingData:
+    """views + likes + item categories (the template's TrainingData)."""
+    views: RatingColumns
+    likes: RatingColumns           # rating +1 like / -1 dislike
+    item_categories: Dict[str, List[str]]
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel: Optional[str] = None
+
+
+class SimilarProductDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        p = self.params
+        views = RatingColumns.from_events(
+            store.find_events(ctx.registry, p.app_name, p.channel,
+                              event_names=["view"]),
+            rating_of=lambda e: 1.0)
+        likes = RatingColumns.from_events(
+            store.find_events(ctx.registry, p.app_name, p.channel,
+                              event_names=["like", "dislike"]),
+            rating_of=lambda e: 1.0 if e.event == "like" else -1.0,
+            dedup_last_wins=True)   # latest like/dislike wins (template doc)
+        cats: Dict[str, List[str]] = {}
+        props = store.aggregate_properties(
+            ctx.registry, p.app_name, channel_name=p.channel,
+            entity_type="item")
+        for item_id, pm in props.items():
+            c = pm.get_opt("categories")
+            if c:
+                cats[item_id] = list(c)
+        return TrainingData(views, likes, cats)
+
+
+def _resolve_filters(model_items: BiMap, item_categories,
+                     query: Query) -> np.ndarray:
+    """Allowed-item mask: categories/white/black lists + the query items
+    themselves excluded (ALSAlgorithm.scala predict filters)."""
+    from predictionio_tpu.models.common import resolve_item_mask
+    query_ix = [ix for it in query.items
+                if (ix := model_items.get(it)) is not None]
+    return resolve_item_mask(
+        model_items, item_categories, categories=query.categories,
+        white_list=query.whiteList, black_list=query.blackList or (),
+        extra_blacklist_ix=query_ix)
+
+
+@dataclass
+class SimilarModel:
+    """Item factors + categories (the P2L productFeatures analog)."""
+    item_factors: np.ndarray
+    items: BiMap
+    item_categories: Dict[str, List[str]]
+
+    def sanity_check(self):
+        assert np.isfinite(self.item_factors).all()
+
+
+class _FactorSimilarityAlgorithm(Algorithm):
+    """Shared predict: cosine top-k against the mean of query-item
+    factors, one jit'd program per batch."""
+
+    query_class = Query
+
+    def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: SimilarModel,
+                      queries: Sequence[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        out: List[Tuple[int, PredictedResult]] = []
+        live = []
+        for i, q in queries:
+            ixs = [ix for it in q.items
+                   if (ix := model.items.get(it)) is not None]
+            if not ixs:   # no known query item -> empty (template logs warn)
+                out.append((i, PredictedResult()))
+            else:
+                live.append((i, q, ixs))
+        if not live:
+            return out
+        n_items = model.item_factors.shape[0]
+        k = max(min(q.num, n_items) for _, q, _ in live)
+        vecs = np.stack([model.item_factors[ixs].mean(axis=0)
+                         for _, _, ixs in live])
+        mask = np.concatenate(
+            [_resolve_filters(model.items, model.item_categories, q)
+             for _, q, _ in live], axis=0)
+        scores, ixs = topk_similar(vecs.astype(np.float32),
+                                   model.item_factors, mask, k=k)
+        scores, ixs = np.asarray(scores), np.asarray(ixs)
+        for row, (i, q, _) in enumerate(live):
+            items = [ItemScore(model.items.inverse(int(ix)), float(s))
+                     for s, ix in zip(scores[row], ixs[row])
+                     if s > NEG_INF / 2][:q.num]
+            out.append((i, PredictedResult(tuple(items))))
+        return out
+
+
+@dataclass(frozen=True)
+class ALSParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+class ALSAlgorithm(_FactorSimilarityAlgorithm):
+    """Implicit ALS on view events (ALSAlgorithm.scala:120)."""
+
+    params_class = ALSParams
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> SimilarModel:
+        p = self.params
+        if pd.views.n == 0:
+            raise ValueError("No view events found "
+                             "(ALSAlgorithm.scala require non-empty)")
+        _, y = als.als_train(
+            pd.views, rank=p.rank, iterations=p.num_iterations,
+            reg=p.lambda_, implicit=True, alpha=p.alpha,
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+        return SimilarModel(y, pd.views.items, pd.item_categories)
+
+
+class LikeAlgorithm(_FactorSimilarityAlgorithm):
+    """Implicit ALS on like(+1)/dislike(-1) events
+    (LikeAlgorithm.scala:37-101)."""
+
+    params_class = ALSParams
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> SimilarModel:
+        p = self.params
+        if pd.likes.n == 0:
+            raise ValueError("No like/dislike events found")
+        _, y = als.als_train(
+            pd.likes, rank=p.rank, iterations=p.num_iterations,
+            reg=p.lambda_, implicit=True, alpha=p.alpha,
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+        return SimilarModel(y, pd.likes.items, pd.item_categories)
+
+
+@dataclass(frozen=True)
+class CooccurrenceParams(Params):
+    n: int = 20   # cooccurrences kept per item
+
+
+@dataclass
+class CoocModel:
+    top: CooccurrenceModel
+    items: BiMap
+    item_categories: Dict[str, List[str]]
+
+
+class CooccurrenceAlgorithm(Algorithm):
+    """(CooccurrenceAlgorithm.scala:47-110)"""
+
+    params_class = CooccurrenceParams
+    query_class = Query
+
+    def train(self, ctx: RuntimeContext, pd: TrainingData) -> CoocModel:
+        views = pd.views
+        c = cooccurrence_matrix(views.user_ix, views.item_ix,
+                                len(views.users), len(views.items))
+        return CoocModel(top_cooccurrences(c, self.params.n),
+                         views.items, pd.item_categories)
+
+    def predict(self, model: CoocModel, query: Query) -> PredictedResult:
+        n_items = len(model.items)
+        scores = np.zeros(n_items, np.float64)
+        for it in query.items:
+            ix = model.items.get(it)
+            if ix is None:
+                continue
+            scores[model.top.top_items[ix]] += model.top.top_counts[ix]
+        mask = _resolve_filters(model.items, model.item_categories, query)[0]
+        scores[~mask] = -np.inf
+        order = np.argsort(-scores)[:query.num]
+        items = [ItemScore(model.items.inverse(int(ix)), float(scores[ix]))
+                 for ix in order if np.isfinite(scores[ix]) and scores[ix] > 0]
+        return PredictedResult(tuple(items))
+
+
+class ScoreAverageServing(Serving):
+    """Average the score per item across algorithms (Serving.scala of
+    multi-events-multi-algos)."""
+
+    def serve(self, query: Query,
+              predictions: Sequence[PredictedResult]) -> PredictedResult:
+        sums: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for p in predictions:
+            for s in p.itemScores:
+                sums[s.item] = sums.get(s.item, 0.0) + s.score
+                counts[s.item] = counts.get(s.item, 0) + 1
+        averaged = [ItemScore(item, sums[item] / counts[item])
+                    for item in sums]
+        averaged.sort(key=lambda s: -s.score)
+        return PredictedResult(tuple(averaged[:query.num]))
+
+
+class SimilarProductEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source=SimilarProductDataSource,
+            preparator=IdentityPreparator,
+            algorithms={"als": ALSAlgorithm, "": ALSAlgorithm,
+                        "likealgo": LikeAlgorithm,
+                        "cooccurrence": CooccurrenceAlgorithm},
+            serving=ScoreAverageServing,
+        )
+
+
+def engine() -> Engine:
+    return SimilarProductEngine.apply()
+
+
+register_engine("similarproduct", SimilarProductEngine)

@@ -1,0 +1,139 @@
+"""Two-tower neural recommender template (new capability).
+
+No reference analog — this is the neural upgrade path from the ALS
+templates (BASELINE.md config 5). Uses the same DataSource event shapes as
+the recommendation template (view/rate/buy interactions) and the same
+query/result wire format, so a user can swap `"engineFactory":
+"recommendation"` for `"twotower"` in engine.json and retrain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm, DataSource, Engine, EngineFactory, FirstServing,
+    IdentityPreparator, Params, RuntimeContext, register_engine,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.ingest import BiMap, RatingColumns
+from predictionio_tpu.models.recommendation import (
+    ItemScore, PredictedResult, Query,
+)
+from predictionio_tpu.ops.topk import NEG_INF, topk_scores
+from predictionio_tpu.ops.twotower import TwoTowerModel, twotower_train
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel: Optional[str] = None
+    event_names: Sequence[str] = ("view", "rate", "buy")
+
+
+class TwoTowerDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> RatingColumns:
+        p = self.params
+        return RatingColumns.from_events(
+            store.find_events(ctx.registry, p.app_name, p.channel,
+                              event_names=list(p.event_names)),
+            rating_of=lambda e: 1.0)
+
+
+@dataclass
+class TwoTowerServingModel:
+    net: TwoTowerModel
+    users: BiMap
+    items: BiMap
+
+    def sanity_check(self):
+        self.net.sanity_check()
+
+
+@dataclass(frozen=True)
+class TwoTowerParams(Params):
+    emb_dim: int = 32
+    hidden: int = 64
+    out_dim: int = 32
+    batch_size: int = 1024
+    epochs: int = 10
+    lr: float = 0.01
+    temperature: float = 0.1
+    seed: Optional[int] = None
+
+
+class TwoTowerAlgorithm(Algorithm):
+    params_class = TwoTowerParams
+    query_class = Query
+
+    def train(self, ctx: RuntimeContext,
+              pd: RatingColumns) -> TwoTowerServingModel:
+        p = self.params
+        if pd.n == 0:
+            raise ValueError("No interaction events found")
+        net = twotower_train(
+            pd.user_ix, pd.item_ix,
+            n_users=len(pd.users), n_items=len(pd.items),
+            emb_dim=p.emb_dim, hidden=p.hidden, out_dim=p.out_dim,
+            batch_size=p.batch_size, epochs=p.epochs, lr=p.lr,
+            temperature=p.temperature,
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+        return TwoTowerServingModel(net, pd.users, pd.items)
+
+    def predict(self, model: TwoTowerServingModel,
+                query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: TwoTowerServingModel,
+                      queries: Sequence[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        out: List[Tuple[int, PredictedResult]] = []
+        live = []
+        for i, q in queries:
+            u = model.users.get(q.user)
+            if u is None:
+                out.append((i, PredictedResult()))
+            else:
+                live.append((i, q, u))
+        if not live:
+            return out
+        n_items = model.net.item_emb.shape[0]
+        k = max(min(q.num, n_items) for _, q, _ in live)
+        vecs = model.net.user_emb[np.array([u for _, _, u in live])]
+        from predictionio_tpu.models.common import resolve_item_mask
+        mask = np.concatenate(
+            [resolve_item_mask(model.items, white_list=q.whiteList,
+                               black_list=q.blackList or ())
+             for _, q, _ in live], axis=0)
+        scores, ixs = topk_scores(vecs.astype(np.float32),
+                                  model.net.item_emb, mask, k=k)
+        scores, ixs = np.asarray(scores), np.asarray(ixs)
+        for row, (i, q, _) in enumerate(live):
+            items = [ItemScore(model.items.inverse(int(ix)), float(s))
+                     for s, ix in zip(scores[row], ixs[row])
+                     if s > NEG_INF / 2][:q.num]
+            out.append((i, PredictedResult(tuple(items))))
+        return out
+
+
+class TwoTowerEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source=TwoTowerDataSource,
+            preparator=IdentityPreparator,
+            algorithms={"twotower": TwoTowerAlgorithm, "": TwoTowerAlgorithm},
+            serving=FirstServing,
+        )
+
+
+def engine() -> Engine:
+    return TwoTowerEngine.apply()
+
+
+register_engine("twotower", TwoTowerEngine)

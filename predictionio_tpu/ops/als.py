@@ -107,9 +107,12 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     rank = factors.shape[1]
     yg = factors[idx]                                   # [B, K, R] gather
     if implicit:
-        conf = alpha * val * msk                        # c - 1
+        # MLlib trainImplicit semantics: confidence c = 1 + alpha*|r|,
+        # preference p = 1 iff r > 0 (negative r = confident dislike)
+        conf = alpha * jnp.abs(val) * msk               # c - 1
+        pref = (val > 0).astype(factors.dtype)
         a = jnp.einsum("bkr,bks,bk->brs", yg, yg, conf) + yty
-        b = jnp.einsum("bkr,bk->br", yg, (1.0 + conf) * msk)
+        b = jnp.einsum("bkr,bk->br", yg, pref * (1.0 + conf) * msk)
     else:
         a = jnp.einsum("bkr,bks,bk->brs", yg, yg, msk)
         b = jnp.einsum("bkr,bk->br", yg, val * msk)
@@ -155,11 +158,6 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     else:
         u_ix, i_ix, val = ratings
         assert n_users is not None and n_items is not None
-    if implicit:
-        # confidence weights must be positive; MLlib requires nonneg input
-        if (val < 0).any():
-            raise ValueError("implicit ALS requires nonnegative ratings")
-
     user_side = _pack_side(u_ix, i_ix, val, n_users)
     item_side = _pack_side(i_ix, u_ix, val, n_items)
 

@@ -1,0 +1,87 @@
+"""Softmax (multinomial logistic) regression by full-batch gradient
+descent with optax.
+
+The classification-template alternative algorithm (the reference's
+templates use MLlib LogisticRegression in downstream variants; SURVEY.md
+§2 lists LogisticRegression among the MLlib kernels to replace). The
+entire train loop is one `lax.scan` over optimizer steps — no Python per
+iteration — and data parallelism comes from sharding the batch dimension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class LogRegModel:
+    w: np.ndarray         # [d, n_classes]
+    b: np.ndarray         # [n_classes]
+    labels: np.ndarray    # [n_classes] original label values
+
+    def sanity_check(self):
+        assert np.isfinite(self.w).all() and np.isfinite(self.b).all()
+
+
+@partial(jax.jit, static_argnames=("n_classes", "steps"))
+def _fit(features, class_ix, *, n_classes: int, steps: int,
+         lr: float, reg: float):
+    import optax
+
+    n, d = features.shape
+    w0 = jnp.zeros((d, n_classes), jnp.float32)
+    b0 = jnp.zeros((n_classes,), jnp.float32)
+    onehot = jax.nn.one_hot(class_ix, n_classes)
+    tx = optax.adam(lr)
+
+    def loss_fn(params):
+        w, b = params
+        logits = features @ w + b
+        ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1))
+        return ce + reg * jnp.sum(w * w)
+
+    def step(carry, _):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    (params, _), losses = jax.lax.scan(
+        step, ((w0, b0), tx.init((w0, b0))), None, length=steps)
+    return params[0], params[1], losses
+
+
+def logreg_train(features: np.ndarray, labels: np.ndarray, *,
+                 steps: int = 200, lr: float = 0.1,
+                 reg: float = 1e-4) -> LogRegModel:
+    if features.shape[0] == 0:
+        raise ValueError("no training points")
+    uniq = np.unique(labels)
+    class_ix = np.searchsorted(uniq, labels).astype(np.int32)
+    # standardize features for conditioning; fold the transform into w/b
+    mu = features.mean(axis=0)
+    sd = features.std(axis=0) + 1e-8
+    fs = ((features - mu) / sd).astype(np.float32)
+    w, b, _ = _fit(jnp.asarray(fs), jnp.asarray(class_ix),
+                   n_classes=len(uniq), steps=steps, lr=lr, reg=reg)
+    w = np.asarray(w) / sd[:, None]
+    b = np.asarray(b) - mu @ w
+    return LogRegModel(w, b, uniq)
+
+
+@jax.jit
+def _logits(w, b, features):
+    return features @ w + b
+
+
+def logreg_predict(model: LogRegModel, features: np.ndarray) -> np.ndarray:
+    logits = np.asarray(_logits(jnp.asarray(model.w), jnp.asarray(model.b),
+                                jnp.asarray(features, jnp.float32)))
+    return model.labels[np.argmax(logits, axis=1)]

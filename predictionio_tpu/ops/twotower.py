@@ -1,0 +1,119 @@
+"""Two-tower neural retrieval model.
+
+A NEW capability beyond the reference (SURVEY.md §7 phase 7 / BASELINE.md
+config 5): embedding towers for users and items trained with in-batch
+sampled softmax on interaction events — the standard neural retrieval
+architecture the reference's ALS templates graduate to.
+
+TPU design: one jit'd train step (embedding lookups -> MLP towers ->
+in-batch softmax loss -> adam update), batch dimension sharded over the
+mesh "data" axis so gradients all-reduce over ICI; inference materializes
+both towers' embeddings once and serves via the same masked top-k matmul
+as every other recommender.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class TwoTowerModel:
+    user_emb: np.ndarray    # [n_users, dim] final tower outputs
+    item_emb: np.ndarray    # [n_items, dim]
+
+    def sanity_check(self):
+        assert np.isfinite(self.user_emb).all()
+        assert np.isfinite(self.item_emb).all()
+
+
+def _init_params(key, n_users: int, n_items: int, emb_dim: int,
+                 hidden: int, out_dim: int):
+    ks = jax.random.split(key, 6)
+    scale = 1.0 / np.sqrt(emb_dim)
+
+    def dense(k, fan_in, fan_out):
+        return (jax.random.normal(k, (fan_in, fan_out), jnp.float32)
+                / np.sqrt(fan_in))
+
+    return {
+        "user_table": jax.random.normal(
+            ks[0], (n_users, emb_dim), jnp.float32) * scale,
+        "item_table": jax.random.normal(
+            ks[1], (n_items, emb_dim), jnp.float32) * scale,
+        "user_w1": dense(ks[2], emb_dim, hidden),
+        "user_w2": dense(ks[3], hidden, out_dim),
+        "item_w1": dense(ks[4], emb_dim, hidden),
+        "item_w2": dense(ks[5], hidden, out_dim),
+    }
+
+
+def _tower(table, w1, w2, ix):
+    h = jax.nn.relu(table[ix] @ w1)
+    out = h @ w2
+    return out / (jnp.linalg.norm(out, axis=-1, keepdims=True) + 1e-8)
+
+
+def _loss_fn(params, u_ix, i_ix, temperature):
+    """In-batch sampled softmax: each (u, i) pair treats the other items
+    in the batch as negatives."""
+    u = _tower(params["user_table"], params["user_w1"], params["user_w2"],
+               u_ix)
+    v = _tower(params["item_table"], params["item_w1"], params["item_w2"],
+               i_ix)
+    logits = (u @ v.T) / temperature                  # [b, b]
+    labels = jnp.arange(u_ix.shape[0])
+    return -jnp.mean(jax.nn.log_softmax(logits, axis=1)[labels, labels])
+
+
+def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
+                   n_users: int, n_items: int,
+                   emb_dim: int = 32, hidden: int = 64, out_dim: int = 32,
+                   batch_size: int = 1024, epochs: int = 10,
+                   lr: float = 1e-2, temperature: float = 0.1,
+                   seed: int = 0, mesh=None) -> TwoTowerModel:
+    """Train on interaction pairs; returns materialized tower embeddings."""
+    import optax
+
+    n = len(u_ix)
+    if n == 0:
+        raise ValueError("no interaction pairs")
+    batch_size = min(batch_size, n)
+    key = jax.random.PRNGKey(seed)
+    params = _init_params(key, n_users, n_items, emb_dim, hidden, out_dim)
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, ub, ib):
+        loss, grads = jax.value_and_grad(_loss_fn)(params, ub, ib,
+                                                   temperature)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    if mesh is not None:
+        from predictionio_tpu.parallel import batch_sharding
+        sharding = batch_sharding(mesh)
+    rng = np.random.RandomState(seed)
+    steps_per_epoch = max(n // batch_size, 1)
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for s in range(steps_per_epoch):
+            sel = order[s * batch_size:(s + 1) * batch_size]
+            ub, ib = jnp.asarray(u_ix[sel]), jnp.asarray(i_ix[sel])
+            if mesh is not None and len(sel) % mesh.devices.size == 0:
+                ub = jax.device_put(ub, sharding)
+                ib = jax.device_put(ib, sharding)
+            params, opt_state, loss = step(params, opt_state, ub, ib)
+
+    user_emb = _tower(params["user_table"], params["user_w1"],
+                      params["user_w2"], jnp.arange(n_users))
+    item_emb = _tower(params["item_table"], params["item_w1"],
+                      params["item_w2"], jnp.arange(n_items))
+    return TwoTowerModel(np.asarray(user_emb), np.asarray(item_emb))

@@ -198,11 +198,22 @@ class TestTraining:
                                    rtol=1e-3, atol=1e-3)
         assert np.allclose(y1[6:], 0)
 
-    def test_implicit_rejects_negative(self):
-        with pytest.raises(ValueError):
-            als.als_train((np.array([0], np.int32), np.array([0], np.int32),
-                           np.array([-1.0], np.float32)), 1, 1,
-                          implicit=True)
+    def test_implicit_dislike_semantics(self):
+        # users 0-9 like items 0-2 (+1), dislike items 3-5 (-1)
+        rows, cols, vals = [], [], []
+        for u in range(10):
+            for i in range(6):
+                rows.append(u)
+                cols.append(i)
+                vals.append(1.0 if i < 3 else -1.0)
+        x, y = als.als_train(
+            (np.array(rows, np.int32), np.array(cols, np.int32),
+             np.array(vals, np.float32)), 10, 6, rank=4, iterations=8,
+            reg=0.01, implicit=True, alpha=40.0)
+        scores = x @ y.T
+        # liked items must score clearly above disliked ones for every user
+        assert (scores[:, :3].mean(axis=1)
+                > scores[:, 3:].mean(axis=1) + 0.3).all()
 
 
 class TestTopK:

@@ -1,0 +1,162 @@
+"""Classification ops + template tests.
+
+Mirrors the reference classification template behavior
+(`examples/scala-parallel-classification/`): NB oracle check against a
+direct numpy computation, LR separability, full engine lifecycle over
+aggregated $set properties, k-fold eval with Accuracy.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import (
+    CoreWorkflow, EngineParams, MetricEvaluator, RuntimeContext,
+    resolve_engine,
+)
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import classification as clf
+from predictionio_tpu.ops import logreg as lr_ops
+from predictionio_tpu.ops import naive_bayes as nb_ops
+
+
+class TestNaiveBayesOp:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 5, (200, 3)).astype(np.float32)
+        y = (x[:, 0] > 2).astype(np.float32)
+        lam = 1.0
+        model = nb_ops.nb_train(x, y, lam)
+        # direct multinomial NB computation
+        for c, label in enumerate(model.labels):
+            sel = y == label
+            pi = np.log(sel.sum() / len(y))
+            sums = x[sel].sum(axis=0)
+            theta = np.log((sums + lam) / (sums.sum() + lam * 3))
+            np.testing.assert_allclose(model.pi[c], pi, rtol=1e-5)
+            np.testing.assert_allclose(model.theta[c], theta, rtol=1e-5)
+
+    def test_prediction_recovers_structure(self):
+        # class 0: features concentrated on dim 0; class 1: on dim 2
+        rng = np.random.RandomState(1)
+        n = 300
+        y = rng.randint(0, 2, n).astype(np.float32)
+        x = np.zeros((n, 3), np.float32)
+        x[y == 0, 0] = rng.poisson(8, (y == 0).sum())
+        x[y == 0, 2] = rng.poisson(1, (y == 0).sum())
+        x[y == 1, 2] = rng.poisson(8, (y == 1).sum())
+        x[y == 1, 0] = rng.poisson(1, (y == 1).sum())
+        x[:, 1] = rng.poisson(3, n)
+        model = nb_ops.nb_train(x, y)
+        acc = (nb_ops.nb_predict(model, x) == y).mean()
+        assert acc > 0.9
+
+    def test_rejects_negative(self):
+        with pytest.raises(ValueError):
+            nb_ops.nb_train(np.array([[-1.0]]), np.array([0.0]))
+
+    def test_proba_sums_to_one(self):
+        x = np.abs(np.random.RandomState(2).randn(20, 3)).astype(np.float32)
+        y = np.arange(20) % 3
+        model = nb_ops.nb_train(x, y.astype(np.float32))
+        proba = nb_ops.nb_predict_proba(model, x)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestLogRegOp:
+    def test_linearly_separable(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(300, 2).astype(np.float32)
+        y = (x[:, 0] + 2 * x[:, 1] > 0).astype(np.float32)
+        model = lr_ops.logreg_train(x, y, steps=300, lr=0.1)
+        acc = (lr_ops.logreg_predict(model, x) == y).mean()
+        assert acc > 0.95
+
+    def test_multiclass_and_label_values(self):
+        rng = np.random.RandomState(3)
+        centers = np.array([[0, 5], [5, 0], [-5, -5]], np.float32)
+        y = rng.randint(0, 3, 300)
+        x = centers[y] + rng.randn(300, 2).astype(np.float32)
+        labels = np.array([10.0, 20.0, 30.0])[y]  # non-contiguous labels
+        model = lr_ops.logreg_train(x, labels, steps=300)
+        pred = lr_ops.logreg_predict(model, x)
+        assert set(np.unique(pred)) <= {10.0, 20.0, 30.0}
+        assert (pred == labels).mean() > 0.95
+
+
+@pytest.fixture()
+def clf_ctx(mem_registry):
+    app_id = mem_registry.get_meta_data_apps().insert(App(0, "clfapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    # plan 0: attr0 high; plan 1: attr2 high (the quickstart's structure)
+    for i in range(120):
+        plan = i % 2
+        a0 = rng.poisson(7) if plan == 0 else rng.poisson(1)
+        a2 = rng.poisson(7) if plan == 1 else rng.poisson(1)
+        events.insert(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({"attr0": int(a0), "attr1": int(rng.poisson(2)),
+                                "attr2": int(a2), "plan": float(plan)})),
+            app_id)
+    return RuntimeContext(registry=mem_registry)
+
+
+class TestClassificationTemplate:
+    def test_lifecycle_both_algorithms(self, clf_ctx):
+        engine = resolve_engine("classification")
+        params = EngineParams(
+            data_source_params=("", clf.DataSourceParams(app_name="clfapp")),
+            algorithm_params_list=(
+                ("naive", clf.NaiveBayesParams(lambda_=1.0)),
+                ("logreg", clf.LogisticRegressionParams(steps=150)),))
+        row = CoreWorkflow.run_train(engine, params, clf_ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(
+            engine, row, clf_ctx)
+        # class-0-looking query
+        q = clf.Query(attr0=8.0, attr1=2.0, attr2=0.0)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        assert all(p.label == 0.0 for p in preds), preds
+        q = clf.Query(attr0=0.0, attr1=2.0, attr2=8.0)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        assert all(p.label == 1.0 for p in preds), preds
+
+    def test_eval_accuracy(self, clf_ctx):
+        engine = resolve_engine("classification")
+        params = EngineParams(
+            data_source_params=("", clf.DataSourceParams(
+                app_name="clfapp", eval_k=3)),
+            algorithm_params_list=(("naive", clf.NaiveBayesParams()),))
+        result = MetricEvaluator(clf.Accuracy()).evaluate(
+            clf_ctx, engine, [params])
+        assert result.best_score.score > 0.85
+
+    def test_custom_attrs(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "custom"))
+        events = mem_registry.get_events()
+        events.init(app_id)
+        for i in range(20):
+            events.insert(Event(
+                event="$set", entity_type="point", entity_id=f"p{i}",
+                properties=DataMap({"fa": i % 4, "fb": (i + 1) % 4,
+                                    "cls": float(i % 2)})), app_id)
+        ctx = RuntimeContext(registry=mem_registry)
+        ds = clf.ClassificationDataSource(clf.DataSourceParams(
+            app_name="custom", entity_type="point",
+            attrs=("fa", "fb"), label="cls"))
+        lp = ds.read_training(ctx)
+        assert lp.features.shape == (20, 2)
+
+    def test_missing_data_raises(self, mem_registry):
+        mem_registry.get_meta_data_apps().insert(App(0, "emptyclf"))
+        ctx = RuntimeContext(registry=mem_registry)
+        ds = clf.ClassificationDataSource(
+            clf.DataSourceParams(app_name="emptyclf"))
+        with pytest.raises(ValueError, match="No 'user' entities"):
+            ds.read_training(ctx)
+
+    def test_query_requires_features(self):
+        with pytest.raises(ValueError):
+            clf.Query(attr0=1.0).vector()
+        assert clf.Query(features=(1, 2)).vector() == [1.0, 2.0]

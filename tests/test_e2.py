@@ -1,0 +1,83 @@
+"""e2 helper tests (mirrors e2/src/test/scala/.../{CategoricalNaiveBayes,
+MarkovChain}Spec and CrossValidationTest)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer, CategoricalNaiveBayes, LabeledPoint, MarkovChain,
+    split_data,
+)
+
+
+class TestCategoricalNB:
+    POINTS = [
+        LabeledPoint("spam", ("cheap", "pills")),
+        LabeledPoint("spam", ("cheap", "watches")),
+        LabeledPoint("ham", ("meeting", "notes")),
+        LabeledPoint("ham", ("cheap", "notes")),
+    ]
+
+    def test_priors_and_likelihoods(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        assert m.priors["spam"] == pytest.approx(np.log(0.5))
+        assert m.likelihoods["spam"][0]["cheap"] == pytest.approx(np.log(1.0))
+        assert m.likelihoods["ham"][0]["cheap"] == pytest.approx(np.log(0.5))
+
+    def test_log_score_and_unseen_default(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        s = m.log_score(LabeledPoint("spam", ("cheap", "pills")))
+        assert s == pytest.approx(np.log(0.5) + np.log(1.0) + np.log(0.5))
+        # unseen feature value with default -inf
+        assert m.log_score(
+            LabeledPoint("spam", ("cheap", "zzz"))) == float("-inf")
+        # with a custom default hook it stays finite
+        s = m.log_score(LabeledPoint("spam", ("cheap", "zzz")),
+                        lambda lls: min(lls))
+        assert np.isfinite(s)
+        # unknown label -> None
+        assert m.log_score(LabeledPoint("eggs", ("cheap", "pills"))) is None
+
+    def test_predict(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        assert m.predict(("cheap", "pills")) == "spam"
+        assert m.predict(("meeting", "notes")) == "ham"
+
+
+class TestMarkovChain:
+    def test_transitions_normalized_topn(self):
+        pairs = [(0, 1)] * 6 + [(0, 2)] * 3 + [(0, 3)] * 1 + [(1, 0)] * 2
+        m = MarkovChain.train(pairs, n_states=4, top_n=2)
+        t0 = dict(m.predict(0))
+        assert t0 == {1: 0.6, 2: 0.3}   # top-2 only, normalized by all 10
+        assert m.predict(1) == [(0, 1.0)]
+        assert m.predict(3) == []       # absorbing state
+
+
+class TestBinaryVectorizer:
+    def test_fit_and_vectorize(self):
+        maps = [{"color": "red", "size": "L"},
+                {"color": "blue", "size": "L"}]
+        v = BinaryVectorizer.fit(maps, ["color", "size"])
+        assert v.num_features == 3   # red, blue, L
+        vec = v.to_vector({"color": "red", "size": "L"})
+        assert vec.sum() == 2.0
+        vec = v.to_vector({"color": "green"})
+        assert vec.sum() == 0.0
+
+
+class TestSplitData:
+    def test_kfold_partition(self):
+        data = list(range(10))
+        folds = split_data(3, data, to_training=list,
+                           to_qa=lambda x: (x, x * 2))
+        assert len(folds) == 3
+        all_test = [q for _, _, qa in folds for q, _ in qa]
+        assert sorted(all_test) == data       # test folds partition data
+        for train, _, qa in folds:
+            test = {q for q, _ in qa}
+            assert set(train) == set(data) - test
+
+    def test_k_must_be_ge_2(self):
+        with pytest.raises(ValueError):
+            split_data(1, [1, 2], list, lambda x: (x, x))

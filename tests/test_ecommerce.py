@@ -1,0 +1,124 @@
+"""E-commerce template tests: three-way predict, serving-time constraint
+events, seen-item filtering, popularity fallback."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import (
+    CoreWorkflow, EngineParams, RuntimeContext, resolve_engine,
+)
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import ecommerce as ec
+
+
+N_USERS, N_ITEMS = 20, 15
+
+
+@pytest.fixture()
+def ec_ctx(mem_registry):
+    app_id = mem_registry.get_meta_data_apps().insert(App(0, "ecapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for i in range(N_ITEMS):
+        events.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": ["even" if i % 2 == 0
+                                               else "odd"]})), app_id)
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if i % 3 == u % 3 and rng.rand() < 0.9:
+                events.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}"), app_id)
+    # i1 is the overwhelmingly bought item (popularity signal)
+    for u in range(12):
+        events.insert(Event(
+            event="buy", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id="i1"), app_id)
+    return RuntimeContext(registry=mem_registry), app_id
+
+
+def train(ctx, **algo_kw):
+    engine = resolve_engine("ecommerce")
+    defaults = dict(app_name="ecapp", rank=6, num_iterations=8, alpha=20.0,
+                    seed=1)
+    defaults.update(algo_kw)
+    params = EngineParams(
+        data_source_params=("", ec.DataSourceParams(app_name="ecapp")),
+        algorithm_params_list=(("ecomm", ec.ECommParams(**defaults)),))
+    row = CoreWorkflow.run_train(engine, params, ctx)
+    algos, models, serving = CoreWorkflow.prepare_deploy(engine, row, ctx)
+    return algos[0], models[0], serving
+
+
+class TestECommPredict:
+    def test_known_user_unseen_filtering(self, ec_ctx):
+        ctx, app_id = ec_ctx
+        algo, model, _ = train(ctx)
+        res = algo.predict(model, ec.Query(user="u0", num=5))
+        assert res.itemScores
+        # u0 has seen most block-0 items; with unseen_only those are
+        # filtered out of the recommendations
+        seen = {e.target_entity_id for e in ctx.registry.get_events().find(
+            app_id, entity_type="user", entity_id="u0",
+            event_names=["view", "buy"])}
+        assert not ({s.item for s in res.itemScores} & seen)
+
+    def test_seen_included_when_unseen_only_false(self, ec_ctx):
+        ctx, _ = ec_ctx
+        algo, model, _ = train(ctx, unseen_only=False)
+        res = algo.predict(model, ec.Query(user="u0", num=5))
+        # block items (mostly seen) should now dominate the top
+        block = [s for s in res.itemScores if int(s.item[1:]) % 3 == 0]
+        assert len(block) >= 3, res.itemScores
+
+    def test_unavailable_constraint_event(self, ec_ctx):
+        ctx, app_id = ec_ctx
+        algo, model, _ = train(ctx, unseen_only=False)
+        base = algo.predict(model, ec.Query(user="u0", num=3))
+        banned = base.itemScores[0].item
+        ctx.registry.get_events().insert(Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties=DataMap({"items": [banned]})), app_id)
+        res = algo.predict(model, ec.Query(user="u0", num=3))
+        assert banned not in [s.item for s in res.itemScores]
+        # constraint can be lifted by a newer $set
+        ctx.registry.get_events().insert(Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties=DataMap({"items": []})), app_id)
+        res = algo.predict(model, ec.Query(user="u0", num=3))
+        assert banned in [s.item for s in res.itemScores]
+
+    def test_unknown_user_recent_similarity(self, ec_ctx):
+        ctx, app_id = ec_ctx
+        algo, model, _ = train(ctx)
+        # new user views two block-0 items, then asks
+        for it in ("i0", "i3"):
+            ctx.registry.get_events().insert(Event(
+                event="view", entity_type="user", entity_id="newbie",
+                target_entity_type="item", target_entity_id=it), app_id)
+        res = algo.predict(model, ec.Query(user="newbie", num=4))
+        assert res.itemScores
+        block_frac = np.mean([int(s.item[1:]) % 3 == 0
+                              for s in res.itemScores])
+        assert block_frac >= 0.5, res.itemScores
+
+    def test_cold_user_popularity_fallback(self, ec_ctx):
+        ctx, _ = ec_ctx
+        algo, model, _ = train(ctx)
+        res = algo.predict(model, ec.Query(user="total-stranger", num=3))
+        assert res.itemScores
+        assert res.itemScores[0].item == "i1"  # the heavily-bought item
+
+    def test_category_filter(self, ec_ctx):
+        ctx, _ = ec_ctx
+        algo, model, _ = train(ctx, unseen_only=False)
+        res = algo.predict(model, ec.Query(user="u0", num=5,
+                                           categories=["even"]))
+        assert res.itemScores
+        assert all(int(s.item[1:]) % 2 == 0 for s in res.itemScores)

@@ -1,0 +1,141 @@
+"""Similar-product template tests: multi-algorithm engine with implicit
+ALS, like/dislike ALS, cooccurrence, and score-averaging serving."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext, resolve_engine
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import similarproduct as sp
+from predictionio_tpu.ops.cooccur import cooccurrence_matrix, top_cooccurrences
+
+
+N_USERS, N_ITEMS = 24, 18
+
+
+@pytest.fixture()
+def sp_ctx(mem_registry):
+    app_id = mem_registry.get_meta_data_apps().insert(App(0, "spapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    # items have categories by i%2; users view items in their block (u%3)
+    for i in range(N_ITEMS):
+        events.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": ["even" if i % 2 == 0
+                                               else "odd"]})), app_id)
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if i % 3 == u % 3 and rng.rand() < 0.9:
+                events.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}"),
+                    app_id)
+                events.insert(Event(
+                    event="like" if rng.rand() < 0.8 else "dislike",
+                    entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}"),
+                    app_id)
+    return RuntimeContext(registry=mem_registry)
+
+
+def params(*algos):
+    return EngineParams(
+        data_source_params=("", sp.DataSourceParams(app_name="spapp")),
+        algorithm_params_list=tuple(algos))
+
+
+class TestCooccurrenceOp:
+    def test_matrix_matches_numpy(self):
+        u = np.array([0, 0, 1, 1, 2], np.int32)
+        i = np.array([0, 1, 0, 1, 1], np.int32)
+        c = cooccurrence_matrix(u, i, 3, 2)
+        # items 0,1 co-viewed by users 0 and 1 -> c01 = 2
+        assert c[0, 1] == 2 and c[1, 0] == 2
+        assert c[0, 0] == 2 and c[1, 1] == 3  # popularity on the diagonal
+
+    def test_dedup_duplicate_views(self):
+        u = np.array([0, 0, 0], np.int32)
+        i = np.array([0, 0, 1], np.int32)
+        c = cooccurrence_matrix(u, i, 1, 2)
+        assert c[0, 1] == 1  # duplicate view of i0 counts once
+
+    def test_top_excludes_self(self):
+        c = np.array([[5.0, 2.0], [2.0, 7.0]])
+        model = top_cooccurrences(c, 1)
+        assert model.top_items[0, 0] == 1
+        assert model.top_counts[0, 0] == 2.0
+
+
+class TestSimilarProductEngine:
+    def test_als_similarity_respects_blocks(self, sp_ctx):
+        engine = resolve_engine("similarproduct")
+        row = CoreWorkflow.run_train(engine, params(
+            ("als", sp.ALSParams(rank=6, num_iterations=8, alpha=20.0,
+                                 seed=1))), sp_ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(
+            engine, row, sp_ctx)
+        q = sp.Query(items=["i0"], num=4)   # block 0
+        res = serving.serve(q, [algos[0].predict(models[0], q)])
+        assert len(res.itemScores) == 4
+        assert "i0" not in [s.item for s in res.itemScores]
+        block_frac = np.mean([int(s.item[1:]) % 3 == 0
+                              for s in res.itemScores])
+        assert block_frac >= 0.75, res.itemScores
+
+    def test_category_whitelist_blacklist(self, sp_ctx):
+        engine = resolve_engine("similarproduct")
+        row = CoreWorkflow.run_train(engine, params(
+            ("als", sp.ALSParams(rank=6, num_iterations=6, seed=1))), sp_ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, sp_ctx)
+        model = models[0]
+        res = algos[0].predict(model, sp.Query(
+            items=["i0"], num=5, categories=["even"]))
+        assert all(int(s.item[1:]) % 2 == 0 for s in res.itemScores)
+        res = algos[0].predict(model, sp.Query(
+            items=["i0"], num=3, whiteList=["i3", "i6"]))
+        assert {s.item for s in res.itemScores} <= {"i3", "i6"}
+        res = algos[0].predict(model, sp.Query(
+            items=["i0"], num=5, blackList=["i3"]))
+        assert "i3" not in [s.item for s in res.itemScores]
+
+    def test_unknown_query_items_empty(self, sp_ctx):
+        engine = resolve_engine("similarproduct")
+        row = CoreWorkflow.run_train(engine, params(
+            ("als", sp.ALSParams(rank=4, num_iterations=3, seed=1))), sp_ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, sp_ctx)
+        res = algos[0].predict(models[0], sp.Query(items=["ghost"], num=3))
+        assert res.itemScores == ()
+
+    def test_multi_algo_serving_averages(self, sp_ctx):
+        engine = resolve_engine("similarproduct")
+        row = CoreWorkflow.run_train(engine, params(
+            ("als", sp.ALSParams(rank=6, num_iterations=6, alpha=20.0,
+                                 seed=1)),
+            ("likealgo", sp.ALSParams(rank=6, num_iterations=6, alpha=20.0,
+                                      seed=2)),
+            ("cooccurrence", sp.CooccurrenceParams(n=10))), sp_ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(
+            engine, row, sp_ctx)
+        q = sp.Query(items=["i0", "i3"], num=5)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        res = serving.serve(q, preds)
+        assert 0 < len(res.itemScores) <= 5
+        scores = [s.score for s in res.itemScores]
+        assert scores == sorted(scores, reverse=True)
+        # averaged score of an item returned by one algo only equals that
+        # algo's score; sanity: every served item exists in some prediction
+        all_items = {s.item for p in preds for s in p.itemScores}
+        assert {s.item for s in res.itemScores} <= all_items
+
+    def test_cooccurrence_predict(self, sp_ctx):
+        engine = resolve_engine("similarproduct")
+        row = CoreWorkflow.run_train(engine, params(
+            ("cooccurrence", sp.CooccurrenceParams(n=10))), sp_ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, sp_ctx)
+        res = algos[0].predict(models[0], sp.Query(items=["i0"], num=4))
+        # co-viewed items are exactly the same-block items
+        assert res.itemScores
+        assert all(int(s.item[1:]) % 3 == 0 for s in res.itemScores)
