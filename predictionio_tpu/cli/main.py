@@ -134,6 +134,13 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("run", help="run a dotted-path function with storage "
                                    "configured (console run analog)")
     x.add_argument("target", help="module.function")
+    x = sub.add_parser("template",
+                       help="scaffold a new engine directory "
+                            "(commands/Template.scala analog)")
+    x.add_argument("template_command", choices=["new"])
+    x.add_argument("directory")
+    x.add_argument("--base", default="recommendation",
+                   help="bundled template to base the scaffold on")
     return p
 
 
@@ -261,6 +268,12 @@ def main(argv: Optional[list] = None) -> int:
                                   channel_id=args.channel,
                                   output_path=args.output)
             _emit({"exported": n})
+            return 0
+        if cmd == "template":
+            path = ops.template_new(args.directory, base=args.base)
+            _emit({"message": f"Engine scaffold created at {path}",
+                   "next": "edit engine.json, then: pio-tpu build && "
+                           "pio-tpu train"})
             return 0
         if cmd == "run":
             import importlib

@@ -283,6 +283,63 @@ def undeploy(ip: str = "127.0.0.1", port: int = 8000) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# template scaffold (commands/Template.scala analog)
+# ---------------------------------------------------------------------------
+
+_SCAFFOLD_ENGINE = '''\
+"""Custom engine scaffold. Wire your DASE components into `engine()` and
+reference this module from engine.json's engineFactory
+("my_engine.engine")."""
+
+from predictionio_tpu.core import Engine, FirstServing, IdentityPreparator
+from predictionio_tpu.models.{base} import (
+    {ds_class} as DataSource,
+    {algo_class} as Algorithm,
+)
+
+
+def engine() -> Engine:
+    return Engine(
+        data_source=DataSource,
+        preparator=IdentityPreparator,
+        algorithms={{"": Algorithm}},
+        serving=FirstServing,
+    )
+'''
+
+_SCAFFOLD_BASES = {
+    "recommendation": ("RecommendationDataSource", "ALSAlgorithm"),
+    "similarproduct": ("SimilarProductDataSource", "ALSAlgorithm"),
+    "classification": ("ClassificationDataSource", "NaiveBayesAlgorithm"),
+    "ecommerce": ("ECommDataSource", "ECommAlgorithm"),
+    "twotower": ("TwoTowerDataSource", "TwoTowerAlgorithm"),
+}
+
+
+def template_new(directory: str, *, base: str = "recommendation") -> str:
+    """Scaffold an engine dir with engine.json + my_engine.py."""
+    if base not in _SCAFFOLD_BASES:
+        raise ValueError(
+            f"Unknown base template {base!r}; known: "
+            f"{sorted(_SCAFFOLD_BASES)}")
+    target = Path(directory)
+    if target.exists() and any(target.iterdir()):
+        raise ValueError(f"Directory {directory} exists and is not empty")
+    target.mkdir(parents=True, exist_ok=True)
+    ds_class, algo_class = _SCAFFOLD_BASES[base]
+    (target / "my_engine.py").write_text(_SCAFFOLD_ENGINE.format(
+        base=base, ds_class=ds_class, algo_class=algo_class))
+    (target / "engine.json").write_text(json.dumps({
+        "id": "default",
+        "description": f"scaffold based on the {base} template",
+        "engineFactory": "my_engine.engine",
+        "datasource": {"params": {"app_name": "myapp"}},
+        "algorithms": [{"name": "", "params": {}}],
+    }, indent=2) + "\n")
+    return str(target)
+
+
+# ---------------------------------------------------------------------------
 # status (commands/Management.scala:99-181)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,42 @@
+"""FakeWorkflow: run arbitrary code through the workflow machinery.
+
+Parity: `core/.../workflow/FakeWorkflow.scala:33-120` — `FakeRun` wraps a
+`SparkContext => Unit` function as a fake engine + evaluator so arbitrary
+Spark code runs with pio's bookkeeping. Here the function takes a
+`RuntimeContext` and runs under an EvaluationInstance record, giving it
+the same observability as a real evaluation.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable
+
+from predictionio_tpu.core.runtime import RuntimeContext
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.data.storage.base import (
+    EvaluationInstance, EvaluationInstanceStatus,
+)
+
+
+def fake_run(fn: Callable[[RuntimeContext], Any],
+             ctx: RuntimeContext, *, label: str = "FakeRun") -> Any:
+    """Run `fn(ctx)`, recording an EvaluationInstance around it
+    (FakeWorkflow.runEval + FakeEvalResult)."""
+    instances = ctx.registry.get_meta_data_evaluation_instances()
+    row = EvaluationInstance(
+        id="", status=EvaluationInstanceStatus.RUNNING,
+        start_time=utcnow(), end_time=utcnow(),
+        evaluation_class=label, batch=ctx.workflow_params.batch)
+    iid = instances.insert(row)
+    row = row.with_(id=iid)
+    try:
+        result = fn(ctx)
+        instances.update(row.with_(
+            status=EvaluationInstanceStatus.COMPLETED, end_time=utcnow(),
+            evaluator_results=repr(result)[:1000]))
+        return result
+    except Exception:
+        traceback.print_exc()
+        instances.update(row.with_(end_time=utcnow()))
+        raise

@@ -87,6 +87,22 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
     batch_size = min(batch_size, n)
     key = jax.random.PRNGKey(seed)
     params = _init_params(key, n_users, n_items, emb_dim, hidden, out_dim)
+    if mesh is not None and "model" in mesh.axis_names:
+        # tensor parallelism: embedding tables row-sharded over "model"
+        # (vocab dim), tower MLPs Megatron-style (w1 col-, w2 row-sharded);
+        # XLA inserts the gathers/reduces over ICI
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(name, arr):
+            spec = {"user_table": P("model", None),
+                    "item_table": P("model", None),
+                    "user_w1": P(None, "model"),
+                    "item_w1": P(None, "model"),
+                    "user_w2": P("model", None),
+                    "item_w2": P("model", None)}[name]
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        params = {k: put(k, v) for k, v in params.items()}
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
@@ -99,7 +115,8 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
 
     if mesh is not None:
         from predictionio_tpu.parallel import batch_sharding
-        sharding = batch_sharding(mesh)
+        sharding = batch_sharding(mesh)          # dim 0 over "data"
+        data_size = int(mesh.shape.get("data", 1))
     rng = np.random.RandomState(seed)
     steps_per_epoch = max(n // batch_size, 1)
     for _ in range(epochs):
@@ -107,7 +124,7 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
         for s in range(steps_per_epoch):
             sel = order[s * batch_size:(s + 1) * batch_size]
             ub, ib = jnp.asarray(u_ix[sel]), jnp.asarray(i_ix[sel])
-            if mesh is not None and len(sel) % mesh.devices.size == 0:
+            if mesh is not None and len(sel) % data_size == 0:
                 ub = jax.device_put(ub, sharding)
                 ib = jax.device_put(ib, sharding)
             params, opt_state, loss = step(params, opt_state, ub, ib)

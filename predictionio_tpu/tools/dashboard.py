@@ -25,11 +25,16 @@ CORS_HEADERS = {"Access-Control-Allow-Origin": "*",
 class DashboardConfig:
     ip: str = "0.0.0.0"
     port: int = 9000
+    server_key: str = ""     # optional key auth (KeyAuthentication analog)
 
 
 class Dashboard(HTTPServerBase):
-    def __init__(self, config: DashboardConfig, registry=None):
-        super().__init__(host=config.ip, port=config.port)
+    def __init__(self, config: DashboardConfig, registry=None,
+                 ssl_context=None):
+        super().__init__(host=config.ip, port=config.port,
+                         ssl_context=ssl_context)
+        from predictionio_tpu.utils.security import KeyAuthentication
+        self.auth = KeyAuthentication(config.server_key or None)
         self.ctx = RuntimeContext(registry=registry)
         self._routes()
 
@@ -41,6 +46,7 @@ class Dashboard(HTTPServerBase):
 
         @r.get("/")
         def index(req: Request) -> Response:
+            self.auth.check(req)
             rows = []
             for i in self._instances().get_completed():
                 iid = html.escape(i.id, quote=True)
@@ -62,6 +68,7 @@ class Dashboard(HTTPServerBase):
         # and the plain <iid> capture would swallow "<id>.json"
         @r.get("/engine_instances/<iid>.json")
         def detail_json(req: Request) -> Response:
+            self.auth.check(req)
             inst = self._instances().get(req.params["iid"])
             if inst is None:
                 return Response.json({"message": "Not Found"}, 404)
@@ -71,6 +78,7 @@ class Dashboard(HTTPServerBase):
 
         @r.get("/engine_instances/<iid>")
         def detail(req: Request) -> Response:
+            self.auth.check(req)
             inst = self._instances().get(req.params["iid"])
             if inst is None:
                 return Response.json({"message": "Not Found"}, 404)

@@ -1,0 +1,54 @@
+"""Server TLS + key authentication.
+
+Parity: `common/.../configuration/SSLConfiguration.scala:32-74` (JKS
+keystore -> sslContext for the spray servers; here PEM cert/key ->
+`ssl.SSLContext`) and `common/.../authentication/KeyAuthentication.scala:
+30-61` (optional server key checked as a query param for dashboard /
+engine-server admin endpoints).
+
+Config keys (from the layered config, `PIO_SERVER_*` — the server.conf
+analog): PIO_SERVER_SSL_CERT, PIO_SERVER_SSL_KEY, PIO_SERVER_SSL_ENFORCED,
+PIO_SERVER_ACCESS_KEY.
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Mapping, Optional
+
+from predictionio_tpu.utils.http import HTTPError, Request, parse_basic_auth_user
+
+
+def ssl_context_from_config(cfg: Mapping[str, str]) -> Optional[ssl.SSLContext]:
+    """Build a server SSLContext from PEM cert/key paths; None when SSL is
+    not configured. Raises when SSL is enforced but unconfigured
+    (SSLConfiguration sslEnforced)."""
+    cert = cfg.get("PIO_SERVER_SSL_CERT")
+    key = cfg.get("PIO_SERVER_SSL_KEY")
+    enforced = cfg.get("PIO_SERVER_SSL_ENFORCED", "").lower() in ("1", "true")
+    if not cert or not key:
+        if enforced:
+            raise ValueError(
+                "PIO_SERVER_SSL_ENFORCED is set but PIO_SERVER_SSL_CERT/"
+                "PIO_SERVER_SSL_KEY are not configured")
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=cert, keyfile=key)
+    return ctx
+
+
+class KeyAuthentication:
+    """Optional server key check (KeyAuthentication.scala:30-61): when a
+    key is configured, requests must present it as ?accessKey= or as the
+    Basic auth username."""
+
+    def __init__(self, server_key: Optional[str] = None):
+        self.server_key = server_key
+
+    def check(self, req: Request) -> None:
+        if not self.server_key:
+            return
+        supplied = req.query.get("accessKey") or parse_basic_auth_user(
+            req.headers)
+        if supplied != self.server_key:
+            raise HTTPError(401, "Invalid accessKey.")

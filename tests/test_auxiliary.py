@@ -1,0 +1,195 @@
+"""Auxiliary subsystem tests: SelfCleaningDataSource, FakeWorkflow,
+SSL/key auth, template scaffold."""
+
+import json
+from datetime import timedelta
+
+import pytest
+
+from predictionio_tpu.cli import ops
+from predictionio_tpu.core import RuntimeContext
+from predictionio_tpu.core.fakeworkflow import fake_run
+from predictionio_tpu.core.selfclean import (
+    EventWindow, SelfCleaningDataSource, parse_duration,
+)
+from predictionio_tpu.data.event import DataMap, Event, utcnow
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.storage.base import EvaluationInstanceStatus
+from predictionio_tpu.utils.http import HTTPError, Request
+from predictionio_tpu.utils.security import (
+    KeyAuthentication, ssl_context_from_config,
+)
+
+
+def ev(event, eid, props=None, t=None, event_id=None):
+    return Event(event=event, entity_type="user", entity_id=eid,
+                 properties=DataMap(props or {}),
+                 event_time=t or utcnow(), event_id=event_id)
+
+
+class TestParseDuration:
+    def test_formats(self):
+        assert parse_duration("3 days") == timedelta(days=3)
+        assert parse_duration("12h") == timedelta(hours=12)
+        assert parse_duration(90) == timedelta(seconds=90)
+        with pytest.raises(ValueError):
+            parse_duration("three days")
+
+
+class Cleaner(SelfCleaningDataSource):
+    def __init__(self, app_name, window):
+        self.app_name = app_name
+        self.event_window = window
+
+
+class TestSelfCleaning:
+    def test_window_filter_exempts_set_events(self):
+        now = utcnow()
+        old = now - timedelta(days=10)
+        events = [
+            ev("view", "u1", t=old),
+            ev("$set", "u1", {"a": 1}, t=old),
+            ev("view", "u2", t=now),
+        ]
+        cleaner = Cleaner("x", EventWindow(duration="1 day"))
+        out = cleaner.cleaned_events(events, now=now)
+        assert {e.event for e in out} == {"$set", "view"}
+        assert len(out) == 2   # the old view is dropped; old $set kept
+
+    def test_compress_set_unset_chain(self):
+        t0 = utcnow()
+        events = [
+            ev("$set", "u1", {"a": 1, "b": 2}, t=t0),
+            ev("$unset", "u1", {"b": None}, t=t0 + timedelta(seconds=1)),
+            ev("$set", "u1", {"c": 3}, t=t0 + timedelta(seconds=2)),
+            ev("view", "u1", t=t0),
+        ]
+        cleaner = Cleaner("x", EventWindow(compress_properties=True))
+        out = cleaner.cleaned_events(events, now=t0)
+        sets = [e for e in out if e.event == "$set"]
+        assert len(sets) == 1
+        assert dict(sets[0].properties.items()) == {"a": 1, "c": 3}
+        assert len([e for e in out if e.event == "view"]) == 1
+
+    def test_remove_duplicates_keeps_first(self):
+        t0 = utcnow()
+        events = [
+            ev("view", "u1", t=t0, event_id="e1"),
+            ev("view", "u1", t=t0 + timedelta(seconds=5), event_id="e2"),
+            ev("view", "u2", t=t0, event_id="e3"),
+        ]
+        cleaner = Cleaner("x", EventWindow(remove_duplicates=True))
+        out = cleaner.cleaned_events(events, now=t0)
+        assert {e.event_id for e in out} == {"e1", "e3"}
+
+    def test_clean_persisted_events(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "cleanapp"))
+        store = mem_registry.get_events()
+        store.init(app_id)
+        now = utcnow()
+        store.insert(ev("view", "u1", t=now - timedelta(days=30)), app_id)
+        store.insert(ev("view", "u1", t=now), app_id)
+        store.insert(ev("$set", "u1", {"a": 1},
+                        t=now - timedelta(days=30)), app_id)
+        store.insert(ev("$set", "u1", {"b": 2}, t=now), app_id)
+        ctx = RuntimeContext(registry=mem_registry)
+        cleaner = Cleaner("cleanapp", EventWindow(
+            duration="7 days", compress_properties=True))
+        removed = cleaner.clean_persisted_events(ctx, now=now)
+        assert removed >= 2   # old view + both original $set events
+        remaining = list(store.find(app_id))
+        sets = [e for e in remaining if e.event == "$set"]
+        assert len(sets) == 1
+        assert dict(sets[0].properties.items()) == {"a": 1, "b": 2}
+        views = [e for e in remaining if e.event == "view"]
+        assert len(views) == 1
+
+    def test_no_window_is_noop(self, mem_registry):
+        cleaner = Cleaner("x", None)
+        events = [ev("view", "u1")]
+        assert cleaner.cleaned_events(events) == events
+
+
+class TestFakeWorkflow:
+    def test_records_instance(self, mem_registry):
+        ctx = RuntimeContext(registry=mem_registry)
+        result = fake_run(lambda c: 41 + 1, ctx, label="MyFake")
+        assert result == 42
+        rows = mem_registry.get_meta_data_evaluation_instances().get_completed()
+        assert rows[0].evaluation_class == "MyFake"
+        assert rows[0].evaluator_results == "42"
+
+    def test_failure_leaves_non_completed(self, mem_registry):
+        ctx = RuntimeContext(registry=mem_registry)
+
+        def boom(c):
+            raise RuntimeError("nope")
+
+        with pytest.raises(RuntimeError):
+            fake_run(boom, ctx)
+        rows = mem_registry.get_meta_data_evaluation_instances().get_all()
+        assert rows[0].status != EvaluationInstanceStatus.COMPLETED
+
+
+def req(query=None, headers=None):
+    return Request(method="GET", path="/", query=query or {},
+                   headers=headers or {}, body=b"")
+
+
+class TestSecurity:
+    def test_key_auth(self):
+        auth = KeyAuthentication("sekret")
+        auth.check(req(query={"accessKey": "sekret"}))
+        import base64
+        basic = base64.b64encode(b"sekret:").decode()
+        auth.check(req(headers={"Authorization": f"Basic {basic}"}))
+        with pytest.raises(HTTPError):
+            auth.check(req())
+        with pytest.raises(HTTPError):
+            auth.check(req(query={"accessKey": "wrong"}))
+        KeyAuthentication(None).check(req())   # disabled -> allow
+
+    def test_ssl_unconfigured(self):
+        assert ssl_context_from_config({}) is None
+        with pytest.raises(ValueError):
+            ssl_context_from_config({"PIO_SERVER_SSL_ENFORCED": "true"})
+
+    def test_dashboard_key_auth(self, mem_registry):
+        from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+        srv = Dashboard(DashboardConfig(ip="127.0.0.1", port=0,
+                                        server_key="dk"), mem_registry)
+        srv.start()
+        try:
+            import urllib.error
+            import urllib.request
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/")
+            assert e.value.code == 401
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/?accessKey=dk") as r:
+                assert r.status == 200
+        finally:
+            srv.shutdown()
+
+
+class TestTemplateScaffold:
+    def test_scaffold_builds(self, tmp_path, mem_registry):
+        target = tmp_path / "my-engine"
+        path = ops.template_new(str(target), base="recommendation")
+        variant = json.loads((target / "engine.json").read_text())
+        assert variant["engineFactory"] == "my_engine.engine"
+        # the scaffold module must actually produce an Engine
+        import sys
+        sys.path.insert(0, str(target))
+        try:
+            from predictionio_tpu.core.workflow import resolve_engine
+            engine = resolve_engine("my_engine.engine")
+            assert engine.algorithm_classes
+        finally:
+            sys.path.remove(str(target))
+            sys.modules.pop("my_engine", None)
+
+    def test_refuses_nonempty(self, tmp_path):
+        (tmp_path / "junk.txt").write_text("x")
+        with pytest.raises(ValueError, match="not empty"):
+            ops.template_new(str(tmp_path))
