@@ -1,0 +1,51 @@
+"""Native (C++) runtime components.
+
+The reference delegates its native heavy lifting to external JVM systems
+(Spark, HBase, Postgres — SURVEY.md §2); here the TPU compute path is
+XLA and the host-side IO plane is C++ compiled on first use:
+
+  eventlog.cpp  append-only event journal (CRC-framed, flock-safe) backing
+                the EVLOG storage driver
+
+`load(name)` compiles `<name>.cpp` with g++ into a cached shared object
+and returns a ctypes handle; callers must handle `None` (no toolchain)
+with a pure-Python fallback so the framework never hard-requires a
+compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).resolve().parent
+_BUILD = _DIR / "_build"
+_lock = threading.Lock()
+_cache = {}
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and dlopen native/<name>.cpp; None on failure."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = _DIR / f"{name}.cpp"
+        so = _BUILD / f"lib{name}.so"
+        lib = None
+        try:
+            if (not so.exists()
+                    or so.stat().st_mtime < src.stat().st_mtime):
+                _BUILD.mkdir(exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
+                     str(src)],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(so))
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,109 @@
+"""Python binding for the C++ event journal, with a pure-Python fallback.
+
+The binding and the fallback implement the same framed format, so a
+journal written by either is readable by both (and by any future tool).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import zlib
+from pathlib import Path
+from typing import Iterator, List, Tuple
+
+from predictionio_tpu import native
+
+MAGIC = 0x50494F45
+_HEADER = struct.Struct("<III")
+
+
+class EventLog:
+    """Append/scan one journal file."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lib = native.load("eventlog")
+        if self._lib is not None:
+            self._lib.el_append.restype = ctypes.c_longlong
+            self._lib.el_append.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_longlong]
+            self._lib.el_index.restype = ctypes.c_longlong
+            self._lib.el_index.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong]
+            self._lib.el_truncate.restype = ctypes.c_int
+            self._lib.el_truncate.argtypes = [ctypes.c_char_p]
+
+    @property
+    def uses_native(self) -> bool:
+        return self._lib is not None
+
+    # -- append -------------------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        if self._lib is not None:
+            off = self._lib.el_append(self.path.encode(), payload,
+                                      len(payload))
+            if off < 0:
+                raise IOError(f"el_append failed for {self.path}")
+            return int(off)
+        return self._py_append(payload)
+
+    def _py_append(self, payload: bytes) -> int:
+        header = _HEADER.pack(MAGIC, len(payload),
+                              zlib.crc32(payload) & 0xFFFFFFFF)
+        with open(self.path, "ab") as f:
+            off = f.tell()
+            f.write(header)
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        return off
+
+    # -- scan ---------------------------------------------------------------
+    def payloads(self) -> Iterator[bytes]:
+        """All valid payloads in append order (torn tails ignored)."""
+        if not Path(self.path).exists():
+            return
+        if self._lib is not None:
+            cap = 1024
+            while True:
+                offs = (ctypes.c_longlong * cap)()
+                lens = (ctypes.c_longlong * cap)()
+                n = self._lib.el_index(self.path.encode(), offs, lens, cap)
+                if n < 0:
+                    raise IOError(f"el_index failed for {self.path}")
+                if n < cap:
+                    break
+                cap *= 4   # journal longer than the index buffer: retry
+            with open(self.path, "rb") as f:
+                for i in range(n):
+                    f.seek(offs[i])
+                    yield f.read(lens[i])
+            return
+        yield from self._py_payloads()
+
+    def _py_payloads(self) -> Iterator[bytes]:
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    return
+                magic, length, crc = _HEADER.unpack(header)
+                if magic != MAGIC or length > (1 << 30):
+                    return
+                payload = f.read(length)
+                if len(payload) < length:
+                    return
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    return
+                yield payload
+
+    def truncate(self) -> None:
+        if self._lib is not None:
+            if self._lib.el_truncate(self.path.encode()) != 0:
+                raise IOError(f"el_truncate failed for {self.path}")
+            return
+        with open(self.path, "wb"):
+            pass

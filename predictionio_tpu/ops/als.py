@@ -133,6 +133,34 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     return jnp.where((n_row > 0)[:, None], x, 0.0)
 
 
+@partial(jax.jit, static_argnames=("implicit", "rank"))
+def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
+             implicit: bool, rank: int):
+    """The full ALS training loop as one compiled program (module-level
+    jit: the cache persists across als_train calls with the same slab
+    shapes). Slabs are pytrees of (rows, idx, val, msk) tuples."""
+    import jax.numpy as jnp
+
+    def half_step(own, opposite, slabs):
+        yty = (opposite.T @ opposite if implicit
+               else jnp.zeros((rank, rank), jnp.float32))
+        for rows_dev, idx, vals, msk in slabs:
+            sol = _solve_bucket(opposite, idx, vals, msk, reg, alpha,
+                                yty, implicit=implicit)
+            # slab-padding rows carry an out-of-bounds row index; 'drop'
+            # discards their updates instead of clamping onto row n-1
+            own = own.at[rows_dev].set(sol, mode="drop")
+        return own
+
+    def body(_, xy):
+        x, y = xy
+        x = half_step(x, y, user_slabs)
+        y = half_step(y, x, item_slabs)
+        return (x, y)
+
+    return jax.lax.fori_loop(0, n_iter, body, (x, y))
+
+
 @jax.jit
 def _predict_elements(x, y, u_ix, i_ix):
     import jax.numpy as jnp
@@ -208,23 +236,9 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
                           jnp.asarray(msk)))
         dev_sides.append(slabs)
 
-    reg_f = jnp.float32(reg)
-    alpha_f = jnp.float32(alpha)
-
-    def half_step(own, opposite, slabs):
-        yty = (opposite.T @ opposite if implicit
-               else jnp.zeros((rank, rank), jnp.float32))
-        for rows_dev, idx, vals, msk in slabs:
-            sol = _solve_bucket(opposite, idx, vals, msk, reg_f, alpha_f,
-                                yty, implicit=implicit)
-            # slab-padding rows carry an out-of-bounds row index; 'drop'
-            # discards their updates instead of clamping onto row n-1
-            own = own.at[rows_dev].set(sol, mode="drop")
-        return own
-
-    for _ in range(iterations):
-        x = half_step(x, y, dev_sides[0])
-        y = half_step(y, x, dev_sides[1])
+    x, y = _run_als(x, y, dev_sides[0], dev_sides[1], jnp.float32(reg),
+                    jnp.float32(alpha), jnp.int32(iterations),
+                    implicit=implicit, rank=rank)
     return np.asarray(x), np.asarray(y)
 
 
