@@ -100,6 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--accesskey", default="",
+                   help="server key when /stop is key-protected")
     x = sub.add_parser("batchpredict")
     x.add_argument("--engine-json", default="engine.json")
     x.add_argument("--engine-factory")
@@ -114,10 +116,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--port", type=int, default=7070)
     x.add_argument("--stats", action="store_true")
     x = sub.add_parser("dashboard")
-    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=9000)
     x = sub.add_parser("adminserver")
-    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=7071)
 
     # misc -----------------------------------------------------------------
@@ -197,6 +199,7 @@ def main(argv: Optional[list] = None) -> int:
             variant = ops.load_variant(args.engine_json)
             factory = ops.resolve_factory_name(variant, args.engine_factory,
                                                args.engine_json)
+            registry = _registry()
             config = ServerConfig(
                 ip=args.ip, port=args.port, engine_factory=factory,
                 engine_variant=variant.get("id", "default"),
@@ -204,14 +207,16 @@ def main(argv: Optional[list] = None) -> int:
                 event_server_ip=args.event_server_ip,
                 event_server_port=args.event_server_port,
                 access_key=args.accesskey,
-                batch_window_ms=args.batch_window_ms)
-            server = PredictionServer(config, registry=_registry())
+                batch_window_ms=args.batch_window_ms,
+                server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
+            server = PredictionServer(config, registry=registry)
             port = server.start()
             print(f"Engine server started on {args.ip}:{port}", flush=True)
             _serve_forever(server)
             return 0
         if cmd == "undeploy":
-            ok = ops.undeploy(args.ip, args.port)
+            ok = ops.undeploy(args.ip, args.port,
+                              access_key=args.accesskey)
             print("Undeployed" if ok else "No server responded")
             return 0 if ok else 1
         if cmd == "batchpredict":

@@ -270,14 +270,26 @@ def _latest_completed(registry, variant_id: str):
     return inst
 
 
-def undeploy(ip: str = "127.0.0.1", port: int = 8000) -> bool:
-    """POST /stop to a running prediction server (Console undeploy)."""
+def undeploy(ip: str = "127.0.0.1", port: int = 8000,
+             access_key: str = "") -> bool:
+    """POST /stop to a running prediction server (Console undeploy).
+    `access_key` is the server key when /stop is key-protected."""
+    import urllib.error
+    import urllib.parse
     import urllib.request
+    suffix = (f"?accessKey={urllib.parse.quote(access_key)}"
+              if access_key else "")
     try:
-        req = urllib.request.Request(f"http://{ip}:{port}/stop",
+        req = urllib.request.Request(f"http://{ip}:{port}/stop{suffix}",
                                      data=b"", method="POST")
         with urllib.request.urlopen(req, timeout=5) as resp:
             return resp.status == 200
+    except urllib.error.HTTPError as e:
+        if e.code == 401:
+            raise ValueError(
+                "Unauthorized: the server's /stop is key-protected; pass "
+                "--accesskey with the server key") from e
+        return False
     except Exception:
         return False
 

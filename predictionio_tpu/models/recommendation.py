@@ -215,7 +215,9 @@ class PrecisionAtK(OptionAverageMetric):
         if not top:
             return 0.0
         hits = sum(1 for item in top if item in positives)
-        return hits / min(self.k, len(top))
+        # Denominator is min(k, |positives|) as in the reference metric —
+        # NOT the number of returned recommendations.
+        return hits / min(self.k, len(positives))
 
 
 # -- engine -----------------------------------------------------------------

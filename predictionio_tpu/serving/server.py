@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import queue
 import random
 import string
 import threading
@@ -50,6 +51,10 @@ class ServerConfig:
     batch_window_ms: int = 0     # 0 = serve each request immediately
     batch_max: int = 64
     verbose: bool = False
+    # Optional server key protecting /reload and /stop (the reference
+    # guards both with authenticate(withAccessKeyFromFile),
+    # CreateServer.scala:624-637). Sourced from PIO_SERVER_ACCESS_KEY.
+    server_key: str = ""
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -91,7 +96,12 @@ class _Deployment:
 
 
 class _MicroBatcher:
-    """Coalesces concurrent requests into device batches."""
+    """Coalesces concurrent requests into device batches.
+
+    Flush scheduling is tracked with an explicit flag cleared under the
+    lock (never Thread.is_alive(), which races with the worker's exit and
+    can strand a request), and device compute always runs OUTSIDE the
+    lock so a flush never stalls concurrent submitters."""
 
     def __init__(self, window_s: float, batch_max: int):
         self.window_s = window_s
@@ -99,19 +109,21 @@ class _MicroBatcher:
         self._lock = threading.Lock()
         # each item: (deployment, query, done event, result slot)
         self._pending: List[tuple] = []
-        self._worker: Optional[threading.Thread] = None
+        self._flush_scheduled = False
 
     def submit(self, deployment: _Deployment, query: Any) -> Any:
         done = threading.Event()
         slot: Dict[str, Any] = {}
+        batch: List[tuple] = []
         with self._lock:
             self._pending.append((deployment, query, done, slot))
             if len(self._pending) >= self.batch_max:
-                self._flush_locked()
-            elif self._worker is None or not self._worker.is_alive():
-                self._worker = threading.Thread(target=self._run_once,
-                                                daemon=True)
-                self._worker.start()
+                batch, self._pending = self._pending, []
+            elif not self._flush_scheduled:
+                self._flush_scheduled = True
+                threading.Thread(target=self._run_once, daemon=True).start()
+        if batch:
+            self._process(batch)
         done.wait()
         if "error" in slot:
             raise slot["error"]
@@ -120,10 +132,13 @@ class _MicroBatcher:
     def _run_once(self):
         time.sleep(self.window_s)
         with self._lock:
-            self._flush_locked()
+            batch, self._pending = self._pending, []
+            # Cleared under the same lock that takes the batch: any submit
+            # after this point schedules a fresh worker, so nothing hangs.
+            self._flush_scheduled = False
+        self._process(batch)
 
-    def _flush_locked(self):
-        pending, self._pending = self._pending, []
+    def _process(self, pending: List[tuple]) -> None:
         if not pending:
             return
         # group by deployment (reload may swap mid-flight)
@@ -151,20 +166,32 @@ class PredictionServer(HTTPServerBase):
                  plugins: Optional[Sequence] = None,
                  engine=None, instance=None):
         super().__init__(host=config.ip, port=config.port)
+        from predictionio_tpu.utils.security import KeyAuthentication
+
         self.config = config
         self.ctx = RuntimeContext(registry=registry)
         self.plugin_context = EngineServerPluginContext(plugins)
+        self.auth = KeyAuthentication(config.server_key or None)
         self._engine_arg = engine
         self._dep: Optional[_Deployment] = None
         self._dep_lock = threading.Lock()
         self._batcher = (_MicroBatcher(config.batch_window_ms / 1000.0,
                                        config.batch_max)
                         if config.batch_window_ms > 0 else None)
-        # latency bookkeeping (CreateServer.scala:399-401,584-591)
+        # latency bookkeeping (CreateServer.scala:399-401,584-591);
+        # updated from concurrent handler threads, hence the lock.
+        self._stats_lock = threading.Lock()
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self.start_time = utcnow()
+        # feedback loop: bounded queue + one worker instead of a thread
+        # per request (send failures logged, not retried,
+        # CreateServer.scala:557-566)
+        self._feedback_queue: "queue.Queue" = queue.Queue(maxsize=1024)
+        if config.feedback:
+            threading.Thread(target=self._drain_feedback,
+                             daemon=True).start()
         self._load(instance)
         self._routes()
 
@@ -214,9 +241,11 @@ class PredictionServer(HTTPServerBase):
         self.plugin_context.notify_sniffers(
             QueryInfo(dep.instance.engine_variant, query, prediction))
         dt = time.perf_counter() - t0
-        self.request_count += 1
-        self.last_serving_sec = dt
-        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        with self._stats_lock:
+            self.request_count += 1
+            self.last_serving_sec = dt
+            self.avg_serving_sec += (
+                (dt - self.avg_serving_sec) / self.request_count)
         out = to_jsonable(prediction)
         if isinstance(out, dict):
             out.update(response_extra)
@@ -224,8 +253,11 @@ class PredictionServer(HTTPServerBase):
 
     def _post_feedback(self, dep: _Deployment, query, prediction,
                        pr_id: str) -> None:
-        """Async POST of the predict event back to the event server; send
-        failures are logged, not retried (CreateServer.scala:557-566)."""
+        """Async POST of the predict event back to the event server via a
+        bounded queue drained by one worker thread (no thread-per-request
+        spawn at serving throughput); send failures are logged, not
+        retried (CreateServer.scala:557-566), and enqueue overflow drops
+        the event with a log line rather than stalling the serve path."""
         data = {
             "event": "predict",
             "eventTime": format_time(utcnow()),
@@ -237,9 +269,15 @@ class PredictionServer(HTTPServerBase):
                 "prediction": to_jsonable(prediction),
             },
         }
+        try:
+            self._feedback_queue.put_nowait(data)
+        except queue.Full:
+            self.log_request_line("Feedback event dropped: queue full")
 
-        def post():
-            import urllib.request
+    def _drain_feedback(self) -> None:
+        import urllib.request
+        while True:
+            data = self._feedback_queue.get()
             url = (f"http://{self.config.event_server_ip}:"
                    f"{self.config.event_server_port}/events.json"
                    f"?accessKey={self.config.access_key or ''}")
@@ -253,8 +291,6 @@ class PredictionServer(HTTPServerBase):
                             f"Feedback event failed. Status: {resp.status}")
             except Exception as e:
                 self.log_request_line(f"Feedback event failed: {e}")
-
-        threading.Thread(target=post, daemon=True).start()
 
     # -- routes ---------------------------------------------------------------
     def _routes(self) -> None:
@@ -289,12 +325,16 @@ class PredictionServer(HTTPServerBase):
         @r.post("/reload")
         def reload(req: Request) -> Response:
             """Hot-swap to the latest COMPLETED instance
-            (CreateServer.scala:316-342)."""
+            (CreateServer.scala:316-342); key-authenticated like the
+            reference's authenticate(withAccessKeyFromFile) guard
+            (CreateServer.scala:624-637)."""
+            self.auth.check(req)
             self._load()
             return Response.json({"message": "Reloaded"})
 
         @r.post("/stop")
         def stop(req: Request) -> Response:
+            self.auth.check(req)
             threading.Thread(target=self.shutdown, daemon=True).start()
             return Response.json({"message": "Shutting down"})
 

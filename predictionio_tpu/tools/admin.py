@@ -19,7 +19,10 @@ from predictionio_tpu.utils.http import HTTPServerBase, Request, Response
 
 @dataclass
 class AdminConfig:
-    ip: str = "0.0.0.0"
+    # localhost default matches AdminAPI.scala:132 — this API exposes
+    # access keys and unauthenticated data deletion, so external binding
+    # must be an explicit opt-in.
+    ip: str = "127.0.0.1"
     port: int = 7071
 
 

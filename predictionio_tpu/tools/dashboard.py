@@ -23,7 +23,9 @@ CORS_HEADERS = {"Access-Control-Allow-Origin": "*",
 
 @dataclass
 class DashboardConfig:
-    ip: str = "0.0.0.0"
+    # localhost default matches Dashboard.scala:41; external binding is
+    # an explicit opt-in.
+    ip: str = "127.0.0.1"
     port: int = 9000
     server_key: str = ""     # optional key auth (KeyAuthentication analog)
 

@@ -174,3 +174,24 @@ class TestVariantJson:
                 "rank": 12, "num_iterations": 5, "lambda_": 0.1}}],
         })
         assert p.algorithm_params_list[0][1].rank == 12
+
+
+class TestPrecisionAtKDenominator:
+    def test_denominator_is_positives_not_returned(self):
+        """With 1 positive and 10 recommendations containing the hit the
+        metric is 1.0 (reference divides by min(k, |positives|))."""
+        metric = rec.PrecisionAtK(k=10, rating_threshold=4.0)
+        p = rec.PredictedResult(tuple(
+            rec.ItemScore(item=f"i{j}", score=10.0 - j) for j in range(10)))
+        a = rec.ActualResult((("i0", 5.0),))
+        q = rec.Query(user="u0", num=10)
+        assert metric.calculate_one(q, p, a) == 1.0
+
+    def test_more_positives_than_k(self):
+        metric = rec.PrecisionAtK(k=2, rating_threshold=4.0)
+        p = rec.PredictedResult((rec.ItemScore("i0", 2.0),
+                                 rec.ItemScore("i9", 1.0)))
+        a = rec.ActualResult((("i0", 5.0), ("i1", 5.0), ("i2", 5.0)))
+        q = rec.Query(user="u0", num=2)
+        # 1 hit / min(k=2, positives=3) = 0.5
+        assert metric.calculate_one(q, p, a) == 0.5

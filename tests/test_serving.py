@@ -241,3 +241,76 @@ class TestMicroBatch:
                    [s["item"] for s in direct[1]["itemScores"]]
         finally:
             srv.shutdown()
+
+
+class TestServerKeyAuth:
+    """/reload and /stop are key-protected when a server key is
+    configured (CreateServer.scala:624-637 authenticate guard)."""
+
+    def test_reload_and_stop_require_key(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, server_key="sekrit")
+        try:
+            code, _ = call(srv.port, "POST", "/queries.json",
+                           {"user": "u1", "num": 2})
+            assert code == 200  # queries are NOT key-gated
+            code, body = call(srv.port, "POST", "/reload")
+            assert code == 401
+            code, _ = call(srv.port, "POST", "/reload?accessKey=sekrit")
+            assert code == 200
+            code, _ = call(srv.port, "POST", "/stop")
+            assert code == 401
+            code, _ = call(srv.port, "POST", "/stop?accessKey=sekrit")
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+    def test_no_key_configured_stays_open(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            code, _ = call(srv.port, "POST", "/reload")
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+
+class TestConcurrencyHardening:
+    def test_request_count_exact_under_hammer(self, trained):
+        """Latency counters are locked: N concurrent requests must count
+        exactly N (no lost read-modify-write updates)."""
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            per_thread, n_threads = 5, 8
+
+            def hammer():
+                for _ in range(per_thread):
+                    code, _ = call(srv.port, "POST", "/queries.json",
+                                   {"user": "u2", "num": 2})
+                    assert code == 200
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert srv.request_count == per_thread * n_threads
+            assert srv.avg_serving_sec > 0.0
+        finally:
+            srv.shutdown()
+
+    def test_microbatch_sequential_requests_never_hang(self, trained):
+        """Regression for the flush-scheduling race: a request arriving
+        as the previous flush worker exits must still get flushed."""
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, batch_window_ms=20)
+        try:
+            for _ in range(5):
+                code, _ = call(srv.port, "POST", "/queries.json",
+                               {"user": "u4", "num": 2})
+                assert code == 200
+                time.sleep(0.03)  # straddle the window boundary
+        finally:
+            srv.shutdown()
