@@ -14,7 +14,10 @@ joda-time millisecond ordering.
 
 from __future__ import annotations
 
+import itertools
 import json
+import threading
+import time
 import uuid
 from dataclasses import dataclass, field, replace
 from datetime import datetime, timezone
@@ -57,6 +60,28 @@ def format_time(t: datetime) -> str:
         t = t.replace(tzinfo=timezone.utc)
     t = t.astimezone(timezone.utc)
     return t.strftime("%Y-%m-%dT%H:%M:%S.") + f"{t.microsecond // 1000:03d}Z"
+
+
+_event_id_seq = itertools.count()
+_event_id_lock = threading.Lock()
+_event_id_last_ns = 0
+
+
+def _gen_event_id() -> str:
+    """Time-ordered 32-hex event id (UUIDv7-style): ns timestamp +
+    process-monotonic counter + randomness. The timestamp is latched to
+    never decrease (wall clock may step backwards), and the counter breaks
+    same-ns ties, so within a process string sort order == insertion
+    order; the stores' (eventTime, id) tie-break is therefore
+    deterministic even when two events land in the same millisecond (the
+    reference relies on backend rowkey ordering for the same property,
+    HBEventsUtil rowkeys)."""
+    global _event_id_last_ns
+    with _event_id_lock:
+        _event_id_last_ns = max(_event_id_last_ns, time.time_ns())
+        ns = _event_id_last_ns
+        seq = next(_event_id_seq)
+    return f"{ns:016x}{seq & 0xFFFFFFFF:08x}{uuid.uuid4().hex[:8]}"
 
 
 _JSON_SCALARS = (type(None), bool, int, float, str)
@@ -242,7 +267,7 @@ class Event:
     event_id: Optional[str] = None
 
     def with_id(self, event_id: Optional[str] = None) -> "Event":
-        return replace(self, event_id=event_id or uuid.uuid4().hex)
+        return replace(self, event_id=event_id or _gen_event_id())
 
     @property
     def event_time_millis(self) -> int:

@@ -22,10 +22,28 @@ XLA program:
   3. Implicit feedback uses the Hu-Koren-Volinsky trick: A_row =
      Y^T Y + sum_k alpha*r_k * y_k y_k^T (+ reg), b_row = sum_k
      (1 + alpha*r_k) y_k, so cost scales with observed entries only.
-  4. Factors live on device across iterations; each bucket slab is sharded
-     over the mesh's "data" axis while the opposite factor matrix is
-     replicated — the all-gather the reference does via Spark shuffle is
-     XLA's job here.
+  4. Factors live on device across iterations. Under a mesh, BOTH factor
+     matrices are block-sharded over the "data" axis (device d owns the
+     contiguous row block [d*B, (d+1)*B)) and every slab is partitioned by
+     the device that owns the rows it solves, so each half-step is: one
+     all-gather of the opposite side's factor shard (transient), a local
+     gather+einsum+Cholesky, and a purely LOCAL factor-row write — no
+     cross-device scatter. The implicit-mode Gram matrix is a [rank,rank]
+     psum of local grams. This is the shard_map analog of MLlib's
+     shuffle-based factor exchange.
+
+Memory model (per device, D devices, f32):
+  persistent:  |X|/D + |Y|/D factor shards, + slab columns /D
+               (idx 4B + val 4B + msk 4B per rating entry, both sides)
+  transient :  the all-gathered opposite factor matrix (|Y| or |X|) +
+               the gathered slab factors [rows_b, cap_b, rank] per bucket
+               (~ratings_on_device * rank * 4B for the largest bucket).
+ML-25M at rank 64 on a v5e-16 slice (16 GiB HBM/chip), counting bucket
+padding (padded entries <= BASE*n_rows + GROWTH*n_ratings per side):
+X = 162541*64*4 = 41.6 MB, Y = 59047*64*4 = 15.1 MB, padded slabs
+~= 2*103e6*12 B / 16 * skew2 ~= 305 MB/device, transient slab gather
+<= 103e6/16 * 64 * 4 * skew2 ~= 3.3 GB — peak ~3.7 GB, inside budget;
+see `hbm_footprint` for the formula and its test.
 
 The returned model is `ALSModel` (factor matrices + BiMaps), the analog of
 the template's fork of `MatrixFactorizationModel` (`ALSModel.scala`).
@@ -59,6 +77,16 @@ class _SideBuckets:
     n_rows: int
 
 
+def _group_offsets(counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Destination coordinates for a ragged->padded scatter of items laid
+    out in stable group order: `member[j]` is item j's group index,
+    `intra[j]` its offset within the group."""
+    total = int(counts.sum())
+    member = np.repeat(np.arange(len(counts)), counts)
+    intra = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
+    return member, intra
+
+
 def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
                n_rows: int) -> _SideBuckets:
     """Group COO entries by row, then bucket rows by degree into padded
@@ -81,10 +109,7 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
         nb = len(rows)
         # ragged -> padded scatter: flat source index for every entry and
         # its (member, intra-row offset) destination, all vectorized
-        total = int(m_counts.sum())
-        member_of = np.repeat(np.arange(nb), m_counts)
-        intra = np.arange(total) - np.repeat(
-            np.cumsum(m_counts) - m_counts, m_counts)
+        member_of, intra = _group_offsets(m_counts)
         src = np.repeat(m_starts, m_counts) + intra
         idx = np.zeros((nb, cap), np.int32)
         vals = np.zeros((nb, cap), np.float32)
@@ -133,6 +158,78 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     return jnp.where((n_row > 0)[:, None], x, 0.0)
 
 
+def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
+    """Re-partition each bucket slab by owning device (owner = row //
+    block) into [n_dev * rows_b, ...] arrays whose dim 0 shards evenly
+    over the mesh: device d's chunk holds only rows it owns, addressed by
+    LOCAL index (row - d*block, fill = block -> dropped scatter).
+    Host-side, vectorized."""
+    packed = []
+    for rows, idx, vals, msk in zip(side.rows, side.idx, side.val, side.msk):
+        owner = rows // block
+        counts = np.bincount(owner, minlength=n_dev)
+        rb = max(int(counts.max()), 1)
+        order = np.argsort(owner, kind="stable")
+        member, intra = _group_offsets(counts)
+        local_rows = np.full((n_dev, rb), block, np.int32)
+        d_idx = np.zeros((n_dev, rb) + idx.shape[1:], idx.dtype)
+        d_val = np.zeros((n_dev, rb) + vals.shape[1:], vals.dtype)
+        d_msk = np.zeros((n_dev, rb) + msk.shape[1:], msk.dtype)
+        local_rows[member, intra] = rows[order] - member * block
+        d_idx[member, intra] = idx[order]
+        d_val[member, intra] = vals[order]
+        d_msk[member, intra] = msk[order]
+        packed.append((local_rows.reshape(n_dev * rb),
+                       d_idx.reshape((n_dev * rb,) + idx.shape[1:]),
+                       d_val.reshape((n_dev * rb,) + vals.shape[1:]),
+                       d_msk.reshape((n_dev * rb,) + msk.shape[1:])))
+    return packed
+
+
+@partial(jax.jit, static_argnames=("implicit", "rank", "mesh"))
+def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
+                     n_iter, *, implicit: bool, rank: int, mesh):
+    """Sharded ALS loop: factor shards stay put; each half-step
+    all-gathers the opposite shard (transient), psums the [rank, rank]
+    Gram for implicit mode, and writes solved rows locally."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def body(x_local, y_local, user_slabs, item_slabs):
+        def half_step(own_local, opp_local, slabs):
+            opp_full = jax.lax.all_gather(opp_local, "data", axis=0,
+                                          tiled=True)
+            if implicit:
+                yty = jax.lax.psum(opp_local.T @ opp_local, "data")
+            else:
+                yty = jnp.zeros((rank, rank), jnp.float32)
+            for local_rows, idx, vals, msk in slabs:
+                sol = _solve_bucket(opp_full, idx, vals, msk, reg, alpha,
+                                    yty, implicit=implicit)
+                # fill rows carry local index == block -> dropped
+                own_local = own_local.at[local_rows].set(sol, mode="drop")
+            return own_local
+
+        def it(_, xy):
+            x_local, y_local = xy
+            x_local = half_step(x_local, y_local, user_slabs)
+            y_local = half_step(y_local, x_local, item_slabs)
+            return (x_local, y_local)
+
+        return jax.lax.fori_loop(0, n_iter, it, (x_local, y_local))
+
+    slab_specs_u = [tuple(P("data", *([None] * (a.ndim - 1)))
+                          for a in slab) for slab in user_slabs]
+    slab_specs_i = [tuple(P("data", *([None] * (a.ndim - 1)))
+                          for a in slab) for slab in item_slabs]
+    fsharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("data", None), P("data", None),
+                  slab_specs_u, slab_specs_i),
+        out_specs=(P("data", None), P("data", None)))
+    return fsharded(x_sh, y_sh, user_slabs, item_slabs)
+
+
 @partial(jax.jit, static_argnames=("implicit", "rank"))
 def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
              implicit: bool, rank: int):
@@ -159,6 +256,40 @@ def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
         return (x, y)
 
     return jax.lax.fori_loop(0, n_iter, body, (x, y))
+
+
+def _train_on_mesh(x, y, user_side, item_side, n_users, n_items, mesh, *,
+                   reg, alpha, iterations, implicit, rank):
+    """Shard inputs and run `_run_als_sharded`; returns the still-sharded
+    device factor arrays (padded to a multiple of the mesh size)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.parallel import batch_sharding, pad_to_multiple
+
+    n_dev = int(mesh.shape["data"])
+    dpad_u = pad_to_multiple(n_users, n_dev)
+    dpad_i = pad_to_multiple(n_items, n_dev)
+    # padding factor rows are zero (they are never solved and must not
+    # bias the psum'd implicit Gram matrix)
+    x_sh = jax.device_put(
+        jnp.pad(x, ((0, dpad_u - x.shape[0]), (0, 0))),
+        batch_sharding(mesh, "data", 2))
+    y_sh = jax.device_put(
+        jnp.pad(y, ((0, dpad_i - y.shape[0]), (0, 0))),
+        batch_sharding(mesh, "data", 2))
+    dev_sides = []
+    for side, block in ((user_side, dpad_u // n_dev),
+                        (item_side, dpad_i // n_dev)):
+        slabs = []
+        for leaves in _pack_by_owner(side, block, n_dev):
+            slabs.append(tuple(
+                jax.device_put(a, batch_sharding(mesh, "data", a.ndim))
+                for a in leaves))
+        dev_sides.append(slabs)
+    return _run_als_sharded(
+        x_sh, y_sh, dev_sides[0], dev_sides[1], jnp.float32(reg),
+        jnp.float32(alpha), jnp.int32(iterations),
+        implicit=implicit, rank=rank, mesh=mesh)
 
 
 @jax.jit
@@ -218,22 +349,20 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     x = jnp.where(jnp.asarray(user_present)[:, None], x, 0.0)
     y = jnp.where(jnp.asarray(item_present)[:, None], y, 0.0)
 
+    if mesh is not None:
+        x_sh, y_sh = _train_on_mesh(
+            x, y, user_side, item_side, n_users, n_items, mesh,
+            reg=reg, alpha=alpha, iterations=iterations,
+            implicit=implicit, rank=rank)
+        return (np.asarray(x_sh)[:n_users], np.asarray(y_sh)[:n_items])
+
     dev_sides = []
-    for side, n_side in ((user_side, n_users), (item_side, n_items)):
+    for side in (user_side, item_side):
         slabs = []
         for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
                                         side.msk):
-            if mesh is not None:
-                from predictionio_tpu.parallel import shard_put
-                idx, _ = shard_put(idx, mesh)
-                vals, _ = shard_put(vals, mesh)
-                msk, _ = shard_put(msk, mesh)
-                # slab-padding rows scatter out of bounds -> dropped
-                rows_dev, _ = shard_put(rows, mesh, fill=n_side)
-            else:
-                rows_dev = jnp.asarray(rows)
-            slabs.append((rows_dev, jnp.asarray(idx), jnp.asarray(vals),
-                          jnp.asarray(msk)))
+            slabs.append((jnp.asarray(rows), jnp.asarray(idx),
+                          jnp.asarray(vals), jnp.asarray(msk)))
         dev_sides.append(slabs)
 
     x, y = _run_als(x, y, dev_sides[0], dev_sides[1], jnp.float32(reg),
@@ -250,6 +379,38 @@ def rmse(x: np.ndarray, y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
     pred = _predict_elements(jnp.asarray(x), jnp.asarray(y),
                              jnp.asarray(u_ix), jnp.asarray(i_ix))
     return float(np.sqrt(np.mean((np.asarray(pred) - val) ** 2)))
+
+
+def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
+                  n_devices: int, *, owner_skew: float = 2.0) -> dict:
+    """Per-device HBM upper bound (bytes, f32) for the sharded ALS layout
+    — the documented memory model (see module docstring).
+
+    Bucket padding is bounded in closed form: a row of degree d lands in a
+    slab of cap(d) <= max(BASE, GROWTH*d), so a side's padded entry count
+    is <= BASE*n_rows + GROWTH*n_ratings. `owner_skew` bounds the extra
+    padding from `_pack_by_owner` equalizing per-device row counts
+    (contiguous id blocks; ~1 for hashed/uniform ids, worst case
+    n_devices for fully skewed ownership). `peak` is persistent + the
+    worst transient (all-gathered opposite factors plus the gathered slab
+    factors [rows_b, cap_b, rank] for the device's share of the heavier
+    padded side)."""
+    fb = 4  # f32 / int32 bytes
+    padded_user = _BUCKET_BASE * n_users + _BUCKET_GROWTH * n_ratings
+    padded_item = _BUCKET_BASE * n_items + _BUCKET_GROWTH * n_ratings
+    factors_local = (n_users + n_items) * rank * fb / n_devices
+    # idx + val + msk per PADDED entry, both sides, sharded with skew
+    slabs_local = ((padded_user + padded_item) * 3 * fb / n_devices
+                   * owner_skew)
+    gathered_opposite = max(n_users, n_items) * rank * fb
+    slab_gather = (max(padded_user, padded_item) * rank * fb / n_devices
+                   * owner_skew)
+    persistent = factors_local + slabs_local
+    return {
+        "persistent": persistent,
+        "transient": gathered_opposite + slab_gather,
+        "peak": persistent + gathered_opposite + slab_gather,
+    }
 
 
 @dataclass
