@@ -1,22 +1,50 @@
-"""Benchmark: ALS train wall-clock + serving throughput on the flagship
-Recommendation workload (MovieLens-100k scale).
+"""Benchmark: the BASELINE.md metric set on the flagship Recommendation
+workload — ALS train wall-clock, held-out RMSE parity against an
+independent numpy oracle, and p50/p99/QPS through the real
+`PredictionServer` /queries.json hot path (with and without
+micro-batching).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line per metric:
+  {"metric", "value", "unit", "vs_baseline"}
+The headline train wall-clock line is printed LAST.
 
-Baseline: the reference publishes no numbers (BASELINE.md), so the
-recorded comparison point is Spark MLlib ALS on ML-100k (rank 10, 10
-iterations) on a multicore CPU driver — commonly reported at ~30 s
-wall-clock for `pio train` including Spark startup; we use a conservative
-20 s compute-only figure. vs_baseline = baseline_seconds / our_seconds
-(higher is better).
+Data: MovieLens-100k-SHAPED SYNTHETIC ratings (943 users x 1682 items,
+100k ratings, planted low-rank structure + noise). The real ml-100k file
+is not redistributable inside this environment (zero egress); metric
+names carry the `synthetic` label.
+
+Baselines (each disclosed, none published by the reference — BASELINE.md
+records that the reference publishes NO numbers):
+  - train: assumed 20 s compute-only Spark-MLlib ALS (rank 10, 10
+    iterations, ML-100k) on a multicore CPU driver — the conservative
+    end of commonly reported `pio train` figures.
+  - RMSE: measured, not assumed — the vs_baseline is oracle_rmse /
+    our_rmse on the same held-out split (>= 1.0 means at least parity);
+    the run HARD-FAILS unless |ours - oracle| < 0.01.
+  - serving: assumed 10 ms p50 / 25 ms p99 / 100 QPS for the reference's
+    single-JVM spray server scoring one query at a time
+    (CreateServer.scala:494 "TODO: Parallelize").
 """
 
 import json
+import threading
 import time
+import urllib.request
 
 import numpy as np
 
-SPARK_CPU_BASELINE_S = 20.0
+SPARK_CPU_TRAIN_BASELINE_S = 20.0
+JVM_SERVE_P50_BASELINE_MS = 10.0
+JVM_SERVE_P99_BASELINE_MS = 25.0
+JVM_SERVE_QPS_BASELINE = 100.0
+
+RANK, ITERS, REG, SEED = 10, 10, 0.05, 0
+
+
+def emit(metric, value, unit, vs_baseline):
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit, "vs_baseline": round(vs_baseline, 2)}),
+          flush=True)
 
 
 def synthetic_ml100k(seed=0):
@@ -32,29 +60,185 @@ def synthetic_ml100k(seed=0):
     return u, i, r.astype(np.float32), n_users, n_items
 
 
-def main():
+def bench_train(u, i, r, n_users, n_items):
     from predictionio_tpu.ops import als
 
-    u, i, r, n_users, n_items = synthetic_ml100k()
-
-    # warm-up: compile all bucket shapes with a single iteration
-    als.als_train((u, i, r), n_users, n_items, rank=10, iterations=1,
-                  reg=0.05, seed=0)
-
+    # warm-up compiles every bucket shape; iteration count is a traced
+    # scalar so the cache carries over to the timed run
+    als.als_train((u, i, r), n_users, n_items, rank=RANK, iterations=1,
+                  reg=REG, seed=SEED)
     t0 = time.perf_counter()
-    x, y = als.als_train((u, i, r), n_users, n_items, rank=10, iterations=10,
-                         reg=0.05, seed=0)
+    als.als_train((u, i, r), n_users, n_items, rank=RANK, iterations=ITERS,
+                  reg=REG, seed=SEED)
     train_s = time.perf_counter() - t0
+    emit("als_train_synthetic_ml100k_rank10_iter10_wallclock", train_s,
+         "seconds", SPARK_CPU_TRAIN_BASELINE_S / train_s)
+    return train_s
 
-    err = als.rmse(x, y, u, i, r)
-    assert err < 1.0, f"RMSE sanity gate failed: {err}"
 
-    print(json.dumps({
-        "metric": "als_train_ml100k_rank10_iter10_wallclock",
-        "value": round(train_s, 4),
-        "unit": "seconds",
-        "vs_baseline": round(SPARK_CPU_BASELINE_S / train_s, 2),
-    }))
+def bench_rmse_parity(u, i, r, n_users, n_items):
+    """Held-out RMSE vs the independent numpy normal-equation oracle at
+    IDENTICAL hyperparameters and starting factors. Hard gate:
+    |ours - oracle| < 0.01."""
+    from predictionio_tpu.ops import als, oracle
+
+    rng = np.random.RandomState(42)
+    test = rng.rand(len(r)) < 0.1
+    ut, it_, rt = u[~test], i[~test], r[~test]
+    uh, ih, rh = u[test], i[test], r[test]
+
+    x, y = als.als_train((ut, it_, rt), n_users, n_items, rank=RANK,
+                         iterations=ITERS, reg=REG, seed=SEED)
+    ours = als.rmse(x, y, uh, ih, rh)
+
+    x0, y0 = als.init_factors(n_users, n_items, RANK, SEED)
+    xo, yo = oracle.als_train(ut, it_, rt, n_users, n_items, rank=RANK,
+                              iterations=ITERS, reg=REG, x0=x0, y0=y0)
+    orc = oracle.rmse(xo, yo, uh, ih, rh)
+
+    delta = abs(ours - orc)
+    if not delta < 0.01:   # explicit: survives python -O
+        raise SystemExit(
+            f"RMSE parity gate FAILED: ours={ours:.4f} oracle={orc:.4f} "
+            f"delta={delta:.4f}")
+    emit("als_heldout_rmse_delta_vs_numpy_oracle", delta, "rmse_abs_delta",
+         orc / ours)
+    return ours, orc
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _deploy_server(u, i, r, n_users, n_items, batch_window_ms=0):
+    """Train through the real engine workflow on an in-memory registry and
+    deploy the real PredictionServer (the /queries.json hot path of
+    CreateServer.scala:470-591)."""
+    from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, StorageRegistry
+    from predictionio_tpu.ingest.arrays import RatingColumns
+    from predictionio_tpu.ingest.bimap import BiMap
+    from predictionio_tpu.models import recommendation as rec
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+
+    registry = StorageRegistry({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    apps = registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "benchapp"))
+    registry.get_events().init(app_id)
+
+    # Bypass 100k single-event inserts: patch the data source read with a
+    # prebuilt RatingColumns (the serve path under test is identical).
+    users = BiMap.from_keys(f"u{n}" for n in range(n_users))
+    items = BiMap.from_keys(f"i{n}" for n in range(n_items))
+    rc = RatingColumns(user_ix=u, item_ix=i, rating=r,
+                       t_millis=np.zeros(len(r), np.int64),
+                       users=users, items=items)
+    orig = rec.RecommendationDataSource._ratings
+    rec.RecommendationDataSource._ratings = lambda self, ctx: rc
+    try:
+        engine = rec.engine()
+        params = EngineParams(
+            data_source_params=("", rec.DataSourceParams(app_name="benchapp")),
+            algorithm_params_list=(("als", rec.ALSAlgorithmParams(
+                rank=RANK, num_iterations=ITERS, lambda_=REG, seed=SEED)),))
+        ctx = RuntimeContext(registry=registry)
+        CoreWorkflow.run_train(engine, params, ctx)
+    finally:
+        rec.RecommendationDataSource._ratings = orig
+
+    config = ServerConfig(ip="127.0.0.1", port=0,
+                          batch_window_ms=batch_window_ms)
+    server = PredictionServer(config, registry=registry, engine=engine)
+    server.start()
+    return server, registry, engine
+
+
+def _qps_hammer(server, label, n_users):
+    """16x40 concurrent requests; any request failure fails the bench
+    (a QPS number must only count completed requests)."""
+    n_threads, per_thread = 16, 40
+    errors = []
+
+    def hammer(tid):
+        try:
+            for k in range(per_thread):
+                _post(server.port,
+                      {"user": f"u{(tid * per_thread + k) % n_users}",
+                       "num": 10})
+        except Exception as e:   # noqa: BLE001 — repropagated below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        raise SystemExit(f"QPS hammer had {len(errors)} failed "
+                         f"threads; first: {errors[0]!r}")
+    qps = n_threads * per_thread / dt
+    emit(f"serve_queries_json_qps_{label}", qps, "qps",
+         qps / JVM_SERVE_QPS_BASELINE)
+
+
+def bench_serving(u, i, r, n_users, n_items):
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+
+    server, registry, engine = _deploy_server(u, i, r, n_users, n_items)
+    try:
+        # warm the compile cache + connection path
+        for n in range(20):
+            _post(server.port, {"user": f"u{n}", "num": 10})
+        lat = []
+        for n in range(300):
+            t0 = time.perf_counter()
+            _post(server.port, {"user": f"u{n % n_users}", "num": 10})
+            lat.append(time.perf_counter() - t0)
+        p50 = float(np.percentile(lat, 50)) * 1e3
+        p99 = float(np.percentile(lat, 99)) * 1e3
+        emit("serve_queries_json_p50", p50, "ms",
+             JVM_SERVE_P50_BASELINE_MS / p50)
+        emit("serve_queries_json_p99", p99, "ms",
+             JVM_SERVE_P99_BASELINE_MS / p99)
+        # same config as the latency server -> reuse it for unbatched QPS
+        _qps_hammer(server, "unbatched", n_users)
+    finally:
+        server.shutdown()
+
+    # second server over the SAME registry + trained instance: the only
+    # difference is the micro-batcher
+    server = PredictionServer(
+        ServerConfig(ip="127.0.0.1", port=0, batch_window_ms=2),
+        registry=registry, engine=engine)
+    server.start()
+    try:
+        for n in range(20):
+            _post(server.port, {"user": f"u{n}", "num": 10})
+        _qps_hammer(server, "microbatch", n_users)
+    finally:
+        server.shutdown()
+
+
+def main():
+    u, i, r, n_users, n_items = synthetic_ml100k()
+    bench_rmse_parity(u, i, r, n_users, n_items)
+    bench_serving(u, i, r, n_users, n_items)
+    # headline metric last (the driver parses the final JSON line)
+    bench_train(u, i, r, n_users, n_items)
 
 
 if __name__ == "__main__":

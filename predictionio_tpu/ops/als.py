@@ -298,6 +298,29 @@ def _predict_elements(x, y, u_ix, i_ix):
     return jnp.einsum("nr,nr->n", x[u_ix], y[i_ix])
 
 
+def init_factors(n_users: int, n_items: int, rank: int, seed: int,
+                 user_present: Optional[np.ndarray] = None,
+                 item_present: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Starting factors (numpy): MLlib init abs(normal)/sqrt(rank) keeps
+    initial predictions O(1). Rows with no ratings are zeroed from the
+    start: they are never solved, and a nonzero phantom row would bias
+    the implicit-mode Gram matrix Y^T Y (MLlib has no factor row at all
+    for such ids). Exposed so the independent numpy oracle
+    (`ops.oracle`) can start from identical factors for parity checks."""
+    key = jax.random.PRNGKey(seed)
+    ku, ki = jax.random.split(key)
+    x = np.abs(np.asarray(jax.random.normal(
+        ku, (max(n_users, 1), rank)))) / math.sqrt(rank)
+    y = np.abs(np.asarray(jax.random.normal(
+        ki, (max(n_items, 1), rank)))) / math.sqrt(rank)
+    if user_present is not None:
+        x = np.where(user_present[:, None], x, 0.0)
+    if item_present is not None:
+        y = np.where(item_present[:, None], y, 0.0)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
 def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray]",
               n_users: Optional[int] = None,
               n_items: Optional[int] = None, *,
@@ -327,27 +350,16 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     user_side = _pack_side(u_ix, i_ix, val, n_users)
     item_side = _pack_side(i_ix, u_ix, val, n_items)
 
-    key = jax.random.PRNGKey(seed)
-    ku, ki = jax.random.split(key)
-    # MLlib init: abs(normal) / sqrt(rank) keeps initial predictions O(1).
-    # Rows with no ratings are zeroed from the start: they are never
-    # solved, and a nonzero phantom row would bias the implicit-mode Gram
-    # matrix Y^T Y (MLlib has no factor row at all for such ids).
-    x = jnp.abs(jax.random.normal(ku, (max(n_users, 1), rank),
-                                  jnp.float32)) / math.sqrt(rank)
-    y = jnp.abs(jax.random.normal(ki, (max(n_items, 1), rank),
-                                  jnp.float32)) / math.sqrt(rank)
-
     def present_mask(side, n_rows):
         present = np.zeros(max(n_rows, 1), bool)
         for rows in side.rows:
             present[rows] = True
         return present
 
-    user_present = present_mask(user_side, n_users)
-    item_present = present_mask(item_side, n_items)
-    x = jnp.where(jnp.asarray(user_present)[:, None], x, 0.0)
-    y = jnp.where(jnp.asarray(item_present)[:, None], y, 0.0)
+    x, y = init_factors(n_users, n_items, rank, seed,
+                        user_present=present_mask(user_side, n_users),
+                        item_present=present_mask(item_side, n_items))
+    x, y = jnp.asarray(x), jnp.asarray(y)
 
     if mesh is not None:
         x_sh, y_sh = _train_on_mesh(

@@ -1,0 +1,69 @@
+"""Independent numpy normal-equation ALS oracle.
+
+Used by the test suite and `bench.py` as the MLlib-equivalent reference
+implementation for RMSE-parity gating (BASELINE.md "RMSE parity as the
+quality gate"; SURVEY.md §7 'Hard parts' — parity against an
+MLlib-equivalent reference). Deliberately the dumbest correct
+implementation: per-row dense normal equations solved with
+`np.linalg.solve`, float64, no bucketing, no padding — shares nothing
+with `ops.als` except the starting factors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def user_step(y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
+              val: np.ndarray, n_users: int, reg: float) -> np.ndarray:
+    """One explicit half-step: solve every user row against fixed y
+    (ALS-WR regularization, lambda scaled by the row's rating count)."""
+    rank = y.shape[1]
+    x = np.zeros((n_users, rank), np.float64)
+    for u in range(n_users):
+        sel = u_ix == u
+        if not sel.any():
+            continue
+        yu = y[i_ix[sel]]
+        a = yu.T @ yu + reg * sel.sum() * np.eye(rank)
+        b = yu.T @ val[sel]
+        x[u] = np.linalg.solve(a, b)
+    return x
+
+
+def user_step_implicit(y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
+                       val: np.ndarray, n_users: int, reg: float,
+                       alpha: float) -> np.ndarray:
+    """One implicit (Hu-Koren-Volinsky) half-step against fixed y."""
+    rank = y.shape[1]
+    yty = y.T @ y
+    x = np.zeros((n_users, rank), np.float64)
+    for u in range(n_users):
+        sel = u_ix == u
+        if not sel.any():
+            continue
+        yu = y[i_ix[sel]]
+        c1 = alpha * val[sel]
+        a = yty + (yu * c1[:, None]).T @ yu + reg * sel.sum() * np.eye(rank)
+        b = yu.T @ (1.0 + c1)
+        x[u] = np.linalg.solve(a, b)
+    return x
+
+
+def als_train(u_ix: np.ndarray, i_ix: np.ndarray, val: np.ndarray,
+              n_users: int, n_items: int, *, rank: int, iterations: int,
+              reg: float, x0: np.ndarray, y0: np.ndarray):
+    """Full alternating loop from the given starting factors (pass the
+    same init as `ops.als.init_factors` for parity comparisons)."""
+    x = np.asarray(x0, np.float64).copy()
+    y = np.asarray(y0, np.float64).copy()
+    for _ in range(iterations):
+        x = user_step(y, u_ix, i_ix, val, n_users, reg)
+        y = user_step(x, i_ix, u_ix, val, n_items, reg)
+    return x, y
+
+
+def rmse(x: np.ndarray, y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
+         val: np.ndarray) -> float:
+    pred = np.einsum("nr,nr->n", x[u_ix], y[i_ix])
+    return float(np.sqrt(np.mean((pred - val) ** 2)))

@@ -3,10 +3,20 @@
 The reference serves queries one at a time and even notes "TODO:
 Parallelize" (`core/.../workflow/CreateServer.scala:494`); its per-query
 work is a driver-side loop over `recommendProducts`
-(`examples/.../ALSAlgorithm.scala:96-112`). Here scoring is one jit'd
+(`examples/.../ALSAlgorithm.scala:96-112`). Here scoring is one
 program: a query batch of user vectors against the full item factor matrix
-(an MXU matmul), additive masks for blacklist/seen/whitelist filters, then
-`lax.top_k` — so batching queries is free.
+(a matmul), additive masks for blacklist/seen/whitelist filters, then
+top-k — so batching queries is free.
+
+Host/device dispatch: `topk_scores`/`topk_similar` route by score-matrix
+size. Small problems (a handful of live queries against a catalog of
+thousands) run as host BLAS in microseconds — pushing them through the
+accelerator costs a dispatch + a device->host readback round trip that
+dwarfs the compute on any hardware, and by orders of magnitude over a
+remote/tunneled device. Large batches (offline batchpredict, eval sweeps,
+big catalogs) go to the jit'd device kernel where the MXU matmul wins and
+the transfer amortizes. Inside a jit trace the device path is always used
+(host numpy cannot trace).
 """
 
 from __future__ import annotations
@@ -20,26 +30,21 @@ import numpy as np
 
 NEG_INF = -1e30
 
+# [b, n_items] score cells below which the host path wins. At the
+# crossover the host matmul is ~1 GFLOP-scale work (milliseconds);
+# above it MXU throughput dominates even counting the readback.
+HOST_CROSSOVER_CELLS = 4 << 20
+
 
 @partial(jax.jit, static_argnames=("k",))
-def topk_scores(user_vecs, item_factors, mask, *, k: int):
-    """scores = U @ Y^T with invalid items masked out.
-
-    user_vecs:    [b, rank]
-    item_factors: [n_items, rank]
-    mask:         [b, n_items] bool — True = item allowed for that query
-    Returns (scores [b, k], indexes [b, k]); masked-out slots score NEG_INF.
-    """
+def _topk_scores_device(user_vecs, item_factors, mask, *, k: int):
     scores = user_vecs @ item_factors.T
     scores = jnp.where(mask, scores, NEG_INF)
     return jax.lax.top_k(scores, k)
 
 
 @partial(jax.jit, static_argnames=("k",))
-def topk_similar(query_vecs, item_factors, mask, *, k: int):
-    """Cosine-similarity top-k: used by the similarproduct template
-    (`examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala`
-    cosine scoring). query_vecs [b, rank] are typically item vectors."""
+def _topk_similar_device(query_vecs, item_factors, mask, *, k: int):
     qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1, keepdims=True)
                        + 1e-9)
     fn = item_factors / (jnp.linalg.norm(item_factors, axis=-1, keepdims=True)
@@ -47,6 +52,64 @@ def topk_similar(query_vecs, item_factors, mask, *, k: int):
     scores = qn @ fn.T
     scores = jnp.where(mask, scores, NEG_INF)
     return jax.lax.top_k(scores, k)
+
+
+def _is_traced(*arrays) -> bool:
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _on_device(*arrays) -> bool:
+    return any(isinstance(a, jax.Array) for a in arrays)
+
+
+def _topk_host(scores: np.ndarray, k: int):
+    """Full stable argsort (cheap at host-path sizes) so tie-breaking
+    matches lax.top_k's lowest-index-first guarantee — the host and
+    device paths must return identical results for the same query."""
+    k = min(k, scores.shape[1])
+    ix = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(scores, ix, axis=1), ix
+
+
+def topk_scores(user_vecs, item_factors, mask, *, k: int):
+    """scores = U @ Y^T with invalid items masked out.
+
+    user_vecs:    [b, rank]
+    item_factors: [n_items, rank]
+    mask:         [b, n_items] bool — True = item allowed for that query
+    Returns (scores [b, k], indexes [b, k]); masked-out slots score NEG_INF.
+    Dispatches host/device by problem size (see module docstring).
+    """
+    traced = _is_traced(user_vecs, item_factors, mask)
+    k = min(k, item_factors.shape[0])   # both paths clamp identically
+    cells = user_vecs.shape[0] * item_factors.shape[0]
+    if traced or _on_device(user_vecs, item_factors) \
+            or cells >= HOST_CROSSOVER_CELLS:
+        out = _topk_scores_device(user_vecs, item_factors, mask, k=k)
+        return out if traced else jax.device_get(out)
+    scores = np.asarray(user_vecs) @ np.asarray(item_factors).T
+    scores = np.where(np.asarray(mask), scores, np.float32(NEG_INF))
+    return _topk_host(scores, k)
+
+
+def topk_similar(query_vecs, item_factors, mask, *, k: int):
+    """Cosine-similarity top-k: used by the similarproduct template
+    (`examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala`
+    cosine scoring). query_vecs [b, rank] are typically item vectors.
+    Dispatches host/device by problem size (see module docstring)."""
+    traced = _is_traced(query_vecs, item_factors, mask)
+    k = min(k, item_factors.shape[0])   # both paths clamp identically
+    cells = query_vecs.shape[0] * item_factors.shape[0]
+    if traced or _on_device(query_vecs, item_factors) \
+            or cells >= HOST_CROSSOVER_CELLS:
+        out = _topk_similar_device(query_vecs, item_factors, mask, k=k)
+        return out if traced else jax.device_get(out)
+    q = np.asarray(query_vecs)
+    f = np.asarray(item_factors)
+    qn = q / (np.linalg.norm(q, axis=-1, keepdims=True) + 1e-9)
+    fn = f / (np.linalg.norm(f, axis=-1, keepdims=True) + 1e-9)
+    scores = np.where(np.asarray(mask), qn @ fn.T, np.float32(NEG_INF))
+    return _topk_host(scores, k)
 
 
 def build_mask(n_items: int,

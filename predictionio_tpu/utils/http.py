@@ -196,7 +196,11 @@ class HTTPServerBase:
             def log_message(self, fmt, *args):  # quiet by default
                 server_ref.log_request_line(fmt % args)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        # Deep listen backlog: the stdlib default of 5 drops connections
+        # (ECONNRESET) under concurrent client bursts
+        _Server = type("_Server", (ThreadingHTTPServer,),
+                       {"request_queue_size": 128})
+        self._httpd = _Server((self.host, self.port), _Handler)
         if self._ssl_context is not None:
             self._httpd.socket = self._ssl_context.wrap_socket(
                 self._httpd.socket, server_side=True)

@@ -7,10 +7,11 @@ quality on a synthetic low-rank matrix; the sharded path must agree with
 the single-device path.
 """
 
+import jax
 import numpy as np
 import pytest
 
-from predictionio_tpu.ops import als
+from predictionio_tpu.ops import als, oracle
 from predictionio_tpu.ops.topk import build_mask, topk_scores, topk_similar
 from predictionio_tpu.parallel import make_mesh
 
@@ -26,35 +27,16 @@ def synthetic(n_users=40, n_items=30, rank=3, density=0.5, seed=1, noise=0.0):
             full[u, i].astype(np.float32))
 
 
+# The numpy normal-equation oracle lives in ops.oracle (promoted so
+# bench.py gates RMSE parity against the same independent implementation).
 def numpy_user_step(y, u_ix, i_ix, val, n_users, reg):
-    """Direct per-user normal-equation solve (the oracle)."""
-    rank = y.shape[1]
-    x = np.zeros((n_users, rank), np.float32)
-    for u in range(n_users):
-        sel = u_ix == u
-        if not sel.any():
-            continue
-        yu = y[i_ix[sel]]
-        a = yu.T @ yu + reg * sel.sum() * np.eye(rank)
-        b = yu.T @ val[sel]
-        x[u] = np.linalg.solve(a, b)
-    return x
+    return oracle.user_step(y, u_ix, i_ix, val, n_users, reg).astype(
+        np.float32)
 
 
 def numpy_user_step_implicit(y, u_ix, i_ix, val, n_users, reg, alpha):
-    rank = y.shape[1]
-    yty = y.T @ y
-    x = np.zeros((n_users, rank), np.float32)
-    for u in range(n_users):
-        sel = u_ix == u
-        if not sel.any():
-            continue
-        yu = y[i_ix[sel]]
-        c1 = alpha * val[sel]
-        a = yty + (yu * c1[:, None]).T @ yu + reg * sel.sum() * np.eye(rank)
-        b = yu.T @ (1.0 + c1)
-        x[u] = np.linalg.solve(a, b)
-    return x
+    return oracle.user_step_implicit(
+        y, u_ix, i_ix, val, n_users, reg, alpha).astype(np.float32)
 
 
 class TestHalfStepOracle:
@@ -289,3 +271,35 @@ class TestShardedFactorLayout:
             shard_rows = {s.data.shape[0] for s in arr.addressable_shards}
             assert shard_rows == {rows // n_dev}, (
                 f"expected {rows // n_dev}-row shards, got {shard_rows}")
+
+
+class TestTopkHostDeviceParity:
+    def test_host_and_device_paths_agree_including_ties(self):
+        """The size-dispatched host path must return exactly what the
+        jit'd device kernel returns — including lowest-index-first
+        tie-breaking (e.g. integer popularity scores tie constantly)."""
+        from predictionio_tpu.ops import topk as tk
+        rng = np.random.RandomState(0)
+        vecs = rng.randint(0, 3, (7, 4)).astype(np.float32)
+        facs = rng.randint(0, 3, (50, 4)).astype(np.float32)
+        mask = rng.rand(7, 50) < 0.8
+        hs, hi = tk._topk_host(
+            np.where(mask, vecs @ facs.T, np.float32(tk.NEG_INF)), 10)
+        ds, di = jax.device_get(
+            tk._topk_scores_device(vecs, facs, mask, k=10))
+        np.testing.assert_allclose(hs, ds, rtol=1e-6)
+        np.testing.assert_array_equal(hi, di)
+
+    def test_public_function_device_route_for_jax_arrays(self):
+        """jax.Array inputs must route to the device kernel (the caller
+        has already committed the data)."""
+        from predictionio_tpu.ops import topk as tk
+        rng = np.random.RandomState(1)
+        vecs = rng.randn(3, 4).astype(np.float32)
+        facs = rng.randn(20, 4).astype(np.float32)
+        mask = np.ones((3, 20), bool)
+        host = tk.topk_scores(vecs, facs, mask, k=5)
+        dev = tk.topk_scores(jax.device_put(vecs), jax.device_put(facs),
+                             jax.device_put(mask), k=5)
+        np.testing.assert_allclose(host[0], dev[0], rtol=1e-5)
+        np.testing.assert_array_equal(host[1], dev[1])
