@@ -1,5 +1,5 @@
-"""Classification template: NaiveBayes + LogisticRegression on aggregated
-entity properties.
+"""Classification template: NaiveBayes + RandomForest (+ LogisticRegression
+bonus) on aggregated entity properties.
 
 Parity target: `examples/scala-parallel-classification/`
   - DataSource aggregates `$set` properties of `user` entities into
@@ -8,9 +8,11 @@ Parity target: `examples/scala-parallel-classification/`
     names via params (`reading-custom-properties` variant)
   - NaiveBayesAlgorithm (MLlib NB -> `ops.naive_bayes`)
     (`NaiveBayesAlgorithm.scala:35-56`)
-  - the reference's RandomForestAlgorithm slot is filled by
-    LogisticRegressionAlgorithm (`ops.logreg`); a tree ensemble is planned
-    (SURVEY.md lists RandomForest among MLlib kernels to replace)
+  - RandomForestAlgorithm (MLlib RandomForest.trainClassifier ->
+    `ops.forest` level-wise histogram forest)
+    (`add-algorithm/src/main/scala/RandomForestAlgorithm.scala:41-72`)
+  - LogisticRegressionAlgorithm (`ops.logreg`) — bonus beyond the
+    reference's algorithm set
   - query `{"attr0": 2, "attr1": 0, "attr2": 0}` ->
     `{"label": 1.0}`
 
@@ -31,6 +33,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import LabeledPoints, labeled_points_from_properties
+from predictionio_tpu.ops import forest as forest_ops
 from predictionio_tpu.ops import logreg as lr_ops
 from predictionio_tpu.ops import naive_bayes as nb_ops
 
@@ -161,6 +164,41 @@ class LogisticRegressionAlgorithm(Algorithm):
                 for (i, _), y in zip(queries, labels)]
 
 
+@dataclass(frozen=True)
+class RandomForestParams(Params):
+    """(RandomForestAlgorithmParams, RandomForestAlgorithm.scala:30-38:
+    numClasses is inferred from the labels rather than declared)."""
+    num_trees: int = 10
+    max_depth: int = 5
+    max_bins: int = 32
+    impurity: str = "gini"
+    feature_subset_strategy: str = "auto"
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    params_class = RandomForestParams
+    query_class = Query
+
+    def train(self, ctx: RuntimeContext,
+              pd: LabeledPoints) -> forest_ops.ForestModel:
+        p = self.params
+        return forest_ops.forest_train(
+            pd.features, pd.label, n_trees=p.num_trees,
+            max_depth=p.max_depth, max_bins=p.max_bins,
+            impurity=p.impurity,
+            feature_subset_strategy=p.feature_subset_strategy, seed=p.seed)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model, queries):
+        feats = np.array([q.vector() for _, q in queries], np.float32)
+        labels = model.predict(feats)
+        return [(i, PredictedResult(float(y)))
+                for (i, _), y in zip(queries, labels)]
+
+
 class Accuracy(AverageMetric):
     """Fraction of correct predictions (the template's Precision
     evaluation generalized to all classes)."""
@@ -176,6 +214,7 @@ class ClassificationEngine(EngineFactory):
             data_source=ClassificationDataSource,
             preparator=IdentityPreparator,
             algorithms={"naive": NaiveBayesAlgorithm, "": NaiveBayesAlgorithm,
+                        "forest": RandomForestAlgorithm,
                         "logreg": LogisticRegressionAlgorithm},
             serving=FirstServing,
         )

@@ -38,7 +38,11 @@ HOST_CROSSOVER_CELLS = 4 << 20
 
 @partial(jax.jit, static_argnames=("k",))
 def _topk_scores_device(user_vecs, item_factors, mask, *, k: int):
-    scores = user_vecs @ item_factors.T
+    # HIGHEST precision: the host path computes exact f32, and the two
+    # paths must rank near-tied scores identically (default TPU matmul
+    # precision is bf16-pass and would reorder them)
+    scores = jnp.matmul(user_vecs, item_factors.T,
+                        precision=jax.lax.Precision.HIGHEST)
     scores = jnp.where(mask, scores, NEG_INF)
     return jax.lax.top_k(scores, k)
 
@@ -49,7 +53,7 @@ def _topk_similar_device(query_vecs, item_factors, mask, *, k: int):
                        + 1e-9)
     fn = item_factors / (jnp.linalg.norm(item_factors, axis=-1, keepdims=True)
                          + 1e-9)
-    scores = qn @ fn.T
+    scores = jnp.matmul(qn, fn.T, precision=jax.lax.Precision.HIGHEST)
     scores = jnp.where(mask, scores, NEG_INF)
     return jax.lax.top_k(scores, k)
 

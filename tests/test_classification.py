@@ -160,3 +160,99 @@ class TestClassificationTemplate:
         with pytest.raises(ValueError):
             clf.Query(attr0=1.0).vector()
         assert clf.Query(features=(1, 2)).vector() == [1.0, 2.0]
+
+
+class TestRandomForestOp:
+    def _separable(self, n, seed):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 4).astype(np.float32)
+        y = np.zeros(n)
+        y[x[:, 0] > 0.5] = 1
+        y[(x[:, 0] <= 0.5) & (x[:, 1] > 0.3)] = 2
+        return x, y
+
+    def test_fits_separable_three_class(self):
+        from predictionio_tpu.ops import forest
+        x, y = self._separable(3000, 0)
+        xt, yt = self._separable(1000, 1)
+        m = forest.forest_train(x, y, n_trees=10, max_depth=5, seed=0)
+        m.sanity_check()
+        acc = (m.predict(xt) == yt).mean()
+        assert acc > 0.95, acc
+
+    def test_accuracy_parity_vs_sklearn(self):
+        """Same shapes/hyperparameters as an independent reference
+        forest: held-out accuracy within 3 points (histogram splits vs
+        exact thresholds account for the tolerance)."""
+        from sklearn.ensemble import RandomForestClassifier
+        from predictionio_tpu.ops import forest
+        x, y = self._separable(3000, 2)
+        xt, yt = self._separable(1000, 3)
+        ours = forest.forest_train(x, y, n_trees=10, max_depth=5, seed=0)
+        theirs = RandomForestClassifier(
+            n_estimators=10, max_depth=5, random_state=0).fit(x, y)
+        acc_ours = (ours.predict(xt) == yt).mean()
+        acc_ref = (theirs.predict(xt) == yt).mean()
+        assert acc_ours > acc_ref - 0.03, (acc_ours, acc_ref)
+
+    def test_noncontiguous_float_labels(self):
+        from predictionio_tpu.ops import forest
+        rng = np.random.RandomState(4)
+        x = rng.randn(500, 3).astype(np.float32)
+        y = np.where(x[:, 0] > 0, 10.0, 30.0)
+        m = forest.forest_train(x, y, n_trees=5, max_depth=3, seed=1)
+        pred = m.predict(x)
+        assert set(np.unique(pred)) <= {10.0, 30.0}
+        assert (pred == y).mean() > 0.9
+
+    def test_entropy_impurity_and_single_tree(self):
+        from predictionio_tpu.ops import forest
+        x, y = self._separable(800, 5)
+        m = forest.forest_train(x, y, n_trees=1, max_depth=4,
+                                impurity="entropy", seed=2)
+        assert (m.predict(x) == y).mean() > 0.9
+
+    def test_pure_node_degrades_gracefully(self):
+        from predictionio_tpu.ops import forest
+        # all-one-class data: every node is pure from the root
+        x = np.random.RandomState(6).randn(100, 3).astype(np.float32)
+        y = np.ones(100)
+        m = forest.forest_train(x, y, n_trees=3, max_depth=4, seed=0)
+        assert (m.predict(x) == 1.0).all()
+
+
+class TestRandomForestTemplate:
+    def test_lifecycle_with_forest(self, clf_ctx):
+        engine = resolve_engine("classification")
+        params = EngineParams(
+            data_source_params=("", clf.DataSourceParams(app_name="clfapp")),
+            algorithm_params_list=(
+                ("forest", clf.RandomForestParams(num_trees=8,
+                                                  max_depth=4)),))
+        row = CoreWorkflow.run_train(engine, params, clf_ctx)
+        algos, models, serving = CoreWorkflow.prepare_deploy(
+            engine, row, clf_ctx)
+        q = clf.Query(attr0=8.0, attr1=2.0, attr2=0.0)
+        assert algos[0].predict(models[0], q).label == 0.0
+        q = clf.Query(attr0=0.0, attr1=2.0, attr2=8.0)
+        assert algos[0].predict(models[0], q).label == 1.0
+
+    def test_forest_accuracy_parity_with_nb_on_eval(self, clf_ctx):
+        """BASELINE.md parity bar: the forest must match NB's accuracy
+        on the template's own k-fold eval."""
+        engine = resolve_engine("classification")
+        nb = EngineParams(
+            data_source_params=("", clf.DataSourceParams(
+                app_name="clfapp", eval_k=3)),
+            algorithm_params_list=(("naive", clf.NaiveBayesParams()),))
+        rf = EngineParams(
+            data_source_params=("", clf.DataSourceParams(
+                app_name="clfapp", eval_k=3)),
+            algorithm_params_list=(
+                ("forest", clf.RandomForestParams(num_trees=8,
+                                                  max_depth=4)),))
+        nb_score = MetricEvaluator(clf.Accuracy()).evaluate(
+            clf_ctx, engine, [nb]).best_score.score
+        rf_score = MetricEvaluator(clf.Accuracy()).evaluate(
+            clf_ctx, engine, [rf]).best_score.score
+        assert rf_score > nb_score - 0.05, (rf_score, nb_score)
