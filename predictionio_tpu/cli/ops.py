@@ -273,15 +273,21 @@ def _latest_completed(registry, variant_id: str):
 def undeploy(ip: str = "127.0.0.1", port: int = 8000,
              access_key: str = "") -> bool:
     """POST /stop to a running prediction server (Console undeploy).
-    `access_key` is the server key when /stop is key-protected."""
+    `access_key` is the server key when /stop is key-protected. The key
+    travels as the Basic-auth username (KeyAuthentication accepts it
+    there), not as a query param, so it never lands in proxy/access
+    logs."""
+    import base64
     import urllib.error
-    import urllib.parse
     import urllib.request
-    suffix = (f"?accessKey={urllib.parse.quote(access_key)}"
-              if access_key else "")
+    headers = {}
+    if access_key:
+        headers["Authorization"] = "Basic " + base64.b64encode(
+            f"{access_key}:".encode()).decode()
     try:
-        req = urllib.request.Request(f"http://{ip}:{port}/stop{suffix}",
-                                     data=b"", method="POST")
+        req = urllib.request.Request(f"http://{ip}:{port}/stop",
+                                     data=b"", method="POST",
+                                     headers=headers)
         with urllib.request.urlopen(req, timeout=5) as resp:
             return resp.status == 200
     except urllib.error.HTTPError as e:

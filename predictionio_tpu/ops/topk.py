@@ -69,10 +69,16 @@ def _on_device(*arrays) -> bool:
 def _topk_host(scores: np.ndarray, k: int):
     """Full stable argsort (cheap at host-path sizes) so tie-breaking
     matches lax.top_k's lowest-index-first guarantee — the host and
-    device paths must return identical results for the same query."""
+    device paths must return identical results for the same query.
+
+    Cross-path parity is exact only for bitwise-equal scores (e.g. the
+    integer-valued factors in the parity tests): the host matmul is exact
+    f32 BLAS while the device path is XLA Precision.HIGHEST, so near-tied
+    (but not equal) scores can still rank differently at the last ulp.
+    Indices are cast to int32 to match lax.top_k's return dtype."""
     k = min(k, scores.shape[1])
     ix = np.argsort(-scores, axis=1, kind="stable")[:, :k]
-    return np.take_along_axis(scores, ix, axis=1), ix
+    return np.take_along_axis(scores, ix, axis=1), ix.astype(np.int32)
 
 
 def topk_scores(user_vecs, item_factors, mask, *, k: int):

@@ -13,6 +13,7 @@ PIO_SERVER_ACCESS_KEY.
 
 from __future__ import annotations
 
+import hmac
 import ssl
 from typing import Mapping, Optional
 
@@ -50,5 +51,7 @@ class KeyAuthentication:
             return
         supplied = req.query.get("accessKey") or parse_basic_auth_user(
             req.headers)
-        if supplied != self.server_key:
+        # constant-time compare: the key gates /reload and /stop, so a
+        # plain != would make it timing-probeable
+        if not hmac.compare_digest(supplied or "", self.server_key):
             raise HTTPError(401, "Invalid accessKey.")
