@@ -122,6 +122,25 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=7071)
 
+    # service ops (bin/pio-start-all, pio-stop-all, pio-daemon) ------------
+    x = sub.add_parser("start-all", help="start event server + dashboard + "
+                                         "admin server as daemons")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--event-server-port", type=int, default=7070)
+    x.add_argument("--dashboard-port", type=int, default=9000)
+    x.add_argument("--admin-port", type=int, default=7071)
+    x.add_argument("--pid-dir")
+    x.add_argument("--log-dir")
+    x = sub.add_parser("stop-all", help="stop all pidfile-tracked services")
+    x.add_argument("--pid-dir")
+    x = sub.add_parser("daemon", help="run a pio-tpu subcommand detached "
+                                      "with a pidfile (bin/pio-daemon)")
+    x.add_argument("--name", required=True)
+    x.add_argument("--pid-dir")
+    x.add_argument("--log-dir")
+    x.add_argument("daemon_argv", nargs=argparse.REMAINDER,
+                   help="subcommand to run, e.g. -- eventserver --port 7070")
+
     # misc -----------------------------------------------------------------
     sub.add_parser("status")
     sub.add_parser("version")
@@ -129,10 +148,12 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--appid", type=int, required=True)
     x.add_argument("--channel", type=int, default=None)
     x.add_argument("--input", required=True)
+    x.add_argument("--format", choices=["json", "parquet"], default="json")
     x = sub.add_parser("export")
     x.add_argument("--appid", type=int, required=True)
     x.add_argument("--channel", type=int, default=None)
     x.add_argument("--output", required=True)
+    x.add_argument("--format", choices=["json", "parquet"], default="json")
     x = sub.add_parser("run", help="run a dotted-path function with storage "
                                    "configured (console run analog)")
     x.add_argument("target", help="module.function")
@@ -258,6 +279,29 @@ def main(argv: Optional[list] = None) -> int:
         if cmd == "status":
             _emit(ops.status(_registry()))
             return 0
+        if cmd == "start-all":
+            from predictionio_tpu.cli import service
+            _emit(service.start_all(
+                ip=args.ip, event_server_port=args.event_server_port,
+                dashboard_port=args.dashboard_port,
+                admin_port=args.admin_port,
+                pid_dir=args.pid_dir, log_dir=args.log_dir))
+            return 0
+        if cmd == "stop-all":
+            from predictionio_tpu.cli import service
+            _emit(service.stop_all(pid_dir=args.pid_dir))
+            return 0
+        if cmd == "daemon":
+            from predictionio_tpu.cli import service
+            argv_rest = list(args.daemon_argv)
+            if argv_rest and argv_rest[0] == "--":   # only the separator
+                argv_rest = argv_rest[1:]
+            if not argv_rest:
+                raise ValueError("daemon needs a subcommand after --")
+            _emit(service.daemonize(argv_rest, name=args.name,
+                                    pid_dir=args.pid_dir,
+                                    log_dir=args.log_dir))
+            return 0
         if cmd == "version":
             import predictionio_tpu
             print(predictionio_tpu.__version__)
@@ -265,13 +309,15 @@ def main(argv: Optional[list] = None) -> int:
         if cmd == "import":
             n = ops.import_events(_registry(), app_id=args.appid,
                                   channel_id=args.channel,
-                                  input_path=args.input)
+                                  input_path=args.input,
+                                  format=args.format)
             _emit({"imported": n})
             return 0
         if cmd == "export":
             n = ops.export_events(_registry(), app_id=args.appid,
                                   channel_id=args.channel,
-                                  output_path=args.output)
+                                  output_path=args.output,
+                                  format=args.format)
             _emit({"exported": n})
             return 0
         if cmd == "template":
@@ -333,6 +379,11 @@ def _accesskey(args) -> int:
         ops.accesskey_delete(registry, args.key)
         _emit({"message": "Deleted"})
     return 0
+
+
+def entrypoint() -> None:   # pragma: no cover - console-script shim
+    """`pio-tpu` console script (pyproject [project.scripts])."""
+    sys.exit(main())
 
 
 if __name__ == "__main__":   # pragma: no cover

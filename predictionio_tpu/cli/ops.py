@@ -395,35 +395,113 @@ def status(registry) -> Dict[str, Any]:
 # import / export (tools/.../{imprt,export})
 # ---------------------------------------------------------------------------
 
+def _require_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+        return pyarrow
+    except ImportError as e:  # pragma: no cover - env dependent
+        raise ValueError(
+            "format='parquet' requires pyarrow, which is not installed; "
+            "use format='json'") from e
+
+
 def import_events(registry, *, app_id: int, input_path: str,
-                  channel_id: Optional[int] = None) -> int:
-    """JSON-lines file -> event store (imprt/FileToEvents.scala:40-106)."""
+                  channel_id: Optional[int] = None,
+                  format: str = "json") -> int:
+    """Events file -> event store (imprt/FileToEvents.scala:40-106).
+    `format` is json (one API-JSON event per line) or parquet (the
+    columnar schema written by `export_events`)."""
     store = registry.get_events()
     store.init(app_id, channel_id)
     n = 0
     batch: List[Event] = []
-    with open(input_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            batch.append(Event.from_api_json(json.loads(line)))
-            if len(batch) >= 500:
-                store.insert_batch(batch, app_id, channel_id)
-                n += len(batch)
-                batch = []
-    if batch:
+
+    def flush():
+        nonlocal n, batch
         store.insert_batch(batch, app_id, channel_id)
         n += len(batch)
+        batch = []
+
+    if format == "parquet":
+        pa = _require_pyarrow()
+        # stream record batches: bounded memory for multi-GB files
+        pf = pa.parquet.ParquetFile(input_path)
+        for rb in pf.iter_batches(batch_size=500):
+            for row in rb.to_pylist():
+                payload = {k: v for k, v in row.items() if v is not None}
+                if "properties" in payload:
+                    payload["properties"] = json.loads(payload["properties"])
+                batch.append(Event.from_api_json(payload))
+                if len(batch) >= 500:
+                    flush()
+    elif format == "json":
+        with open(input_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                batch.append(Event.from_api_json(json.loads(line)))
+                if len(batch) >= 500:
+                    flush()
+    else:
+        raise ValueError(f"Unknown import format {format!r} "
+                         "(expected 'json' or 'parquet')")
+    if batch:
+        flush()
     return n
 
 
 def export_events(registry, *, app_id: int, output_path: str,
-                  channel_id: Optional[int] = None) -> int:
-    """Event store -> JSON-lines file (export/EventsToFile.scala:40-108)."""
+                  channel_id: Optional[int] = None,
+                  format: str = "json") -> int:
+    """Event store -> file (export/EventsToFile.scala:40-108 supports
+    text|parquet; so does this). The parquet schema is the API-JSON
+    fields as columns, with `properties` as a JSON-encoded string column
+    (schemaless property bags don't have a static arrow schema)."""
+    events_iter = registry.get_events().find(app_id, channel_id)
+    if format == "parquet":
+        pa = _require_pyarrow()
+        cols = ["eventId", "event", "entityType", "entityId",
+                "targetEntityType", "targetEntityId", "properties",
+                "eventTime", "tags", "prId", "creationTime"]
+        schema = pa.schema(
+            [(c, pa.list_(pa.string()) if c == "tags" else pa.string())
+             for c in cols])
+        n = 0
+        writer = None
+        try:
+            chunk: List[dict] = []
+
+            def write_chunk():
+                nonlocal writer, n
+                data = {c: [r.get(c) for r in chunk] for c in cols}
+                table = pa.table(data, schema=schema)
+                if writer is None:
+                    writer = pa.parquet.ParquetWriter(output_path, schema)
+                writer.write_table(table)
+                n += len(chunk)
+
+            for e in events_iter:
+                d = e.to_api_json()
+                if "properties" in d:
+                    d["properties"] = json.dumps(d["properties"])
+                chunk.append(d)
+                if len(chunk) >= 5000:
+                    write_chunk()
+                    chunk = []
+            if chunk or writer is None:
+                write_chunk()
+        finally:
+            if writer is not None:
+                writer.close()
+        return n
+    if format != "json":
+        raise ValueError(f"Unknown export format {format!r} "
+                         "(expected 'json' or 'parquet')")
     n = 0
     with open(output_path, "w") as f:
-        for e in registry.get_events().find(app_id, channel_id):
+        for e in events_iter:
             f.write(json.dumps(e.to_api_json()) + "\n")
             n += 1
     return n

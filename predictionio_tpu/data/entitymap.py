@@ -1,0 +1,109 @@
+"""Typed entity collections keyed by entity id, with a dense-index BiMap.
+
+Parity: `data/.../storage/EntityMap.scala` (`EntityIdIxMap` + `EntityMap`,
+99 LoC) and its builder `PEvents.extractEntityMap` (`PEvents.scala:136+`):
+a map entityId -> T whose ids are simultaneously assigned contiguous
+indexes [0, n) so model code can address entities as dense array rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, Iterator, Optional, TypeVar
+
+from predictionio_tpu.data.event import PropertyMap
+from predictionio_tpu.ingest.bimap import BiMap
+
+T = TypeVar("T")
+
+
+class EntityIdIxMap:
+    """entityId <-> dense index bridge (EntityMap.scala's EntityIdIxMap,
+    itself a BiMap[String, Long] wrapper)."""
+
+    def __init__(self, bimap: BiMap):
+        self._bimap = bimap
+
+    @staticmethod
+    def from_ids(ids) -> "EntityIdIxMap":
+        return EntityIdIxMap(BiMap.from_keys(ids))
+
+    def __call__(self, entity_id: str) -> int:
+        return self._bimap(entity_id)
+
+    def __contains__(self, entity_id: str) -> bool:
+        return entity_id in self._bimap
+
+    def get(self, entity_id: str) -> Optional[int]:
+        return self._bimap.get(entity_id)
+
+    def ix_to_id(self, ix: int) -> str:
+        return self._bimap.inverse(ix)
+
+    def __len__(self) -> int:
+        return len(self._bimap)
+
+    @property
+    def bimap(self) -> BiMap:
+        return self._bimap
+
+
+class EntityMap(Generic[T]):
+    """Immutable entityId -> T collection with dense indexing
+    (EntityMap.scala: apply/getOrElse/contains/size + ixToId)."""
+
+    def __init__(self, data: Dict[str, T],
+                 id_to_ix: Optional[EntityIdIxMap] = None):
+        self._data = dict(data)
+        self._ids = id_to_ix or EntityIdIxMap.from_ids(self._data.keys())
+
+    def __call__(self, entity_id: str) -> T:
+        """Apply; KeyError on unknown id (EntityMap.apply)."""
+        return self._data[entity_id]
+
+    def get(self, entity_id: str, default: Optional[T] = None) -> Optional[T]:
+        return self._data.get(entity_id, default)
+
+    def __contains__(self, entity_id: str) -> bool:
+        return entity_id in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def items(self):
+        return self._data.items()
+
+    @property
+    def id_to_ix(self) -> EntityIdIxMap:
+        return self._ids
+
+    def by_ix(self, ix: int) -> T:
+        """Dense index -> value (EntityMap.ixToId composed with apply)."""
+        return self._data[self._ids.ix_to_id(ix)]
+
+    def map_values(self, fn: Callable[[T], object]) -> "EntityMap":
+        """Same ids/indexes, transformed values."""
+        return EntityMap({k: fn(v) for k, v in self._data.items()},
+                         self._ids)
+
+
+def entity_map_from_properties(registry, app_name: str, *,
+                               entity_type: str,
+                               extract: Optional[Callable[[PropertyMap], T]]
+                               = None,
+                               channel_name: Optional[str] = None,
+                               **filters) -> EntityMap:
+    """Aggregate `$set/$unset/$delete` properties for every entity of a
+    type and wrap them in an EntityMap (PEvents.extractEntityMap analog).
+    `extract` converts each PropertyMap to the model's value type;
+    omitted, values are the PropertyMaps themselves."""
+    from predictionio_tpu.data.store import aggregate_properties
+
+    props = aggregate_properties(registry, app_name,
+                                 entity_type=entity_type,
+                                 channel_name=channel_name, **filters)
+    data = {eid: (extract(pm) if extract is not None else pm)
+            for eid, pm in props.items()}
+    return EntityMap(data)

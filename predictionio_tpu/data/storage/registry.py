@@ -59,6 +59,16 @@ def _register_builtin_drivers() -> None:
     register_driver("LOCALFS", localfs.LocalFSStorageClient, {
         "Models": localfs.LocalFSModels,
     })
+    from predictionio_tpu.data.storage import objectstore
+
+    # S3/HDFS are the reference's driver names (S3Models.scala,
+    # HDFSModels.scala); OBJECTSTORE is the generic fsspec-URL form.
+    # fsspec itself is imported lazily at client construction, so a
+    # missing fsspec surfaces as a clear StorageError only when an
+    # object-store source is actually used.
+    for type_name in ("OBJECTSTORE", "S3", "HDFS"):
+        register_driver(type_name, objectstore.ObjectStoreStorageClient,
+                        {"Models": objectstore.ObjectStoreModels})
 
 
 _register_builtin_drivers()
@@ -125,9 +135,20 @@ class StorageRegistry:
             # zero-config default: one sqlite file source for everything
             sources = {"PIO": {"TYPE": "SQLITE",
                                "PATH": "./.pio_store/pio.db"}}
+        # a repository without an explicit SOURCE binds to the first
+        # source whose driver actually supports the DAOs that repo needs
+        # (a Models-only object store must not become the METADATA repo)
+        needs = {"METADATA": "Apps", "EVENTDATA": "Events",
+                 "MODELDATA": "Models"}
         for repo in REPOSITORIES:
             repos.setdefault(repo, {})
-            repos[repo].setdefault("SOURCE", next(iter(sources)))
+            if "SOURCE" not in repos[repo]:
+                candidates = [
+                    name for name, scfg in sources.items()
+                    if needs[repo] in DRIVERS.get(
+                        scfg.get("TYPE", "").upper(), {}).get("daos", {})]
+                repos[repo]["SOURCE"] = (candidates[0] if candidates
+                                         else next(iter(sources)))
             repos[repo].setdefault("NAME", "pio_" + repo.lower())
         for name, scfg in sources.items():
             if "TYPE" not in scfg:

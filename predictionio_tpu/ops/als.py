@@ -17,8 +17,9 @@ XLA program:
   2. One half-iteration gathers the opposite side's factors `Y[idx]`
      (`[rows_b, cap_b, rank]`), forms per-row normal equations with one
      einsum (MXU-batched), adds ALS-WR regularization `lambda * n_row * I`
-     (MLlib's default scaling), and solves all rows with one batched
-     Cholesky (`jax.scipy.linalg.cho_solve`).
+     (MLlib's default scaling), and solves all rows with batched
+     Jacobi-preconditioned CG (`ops.linalg.pcg_solve` — XLA's batched
+     Cholesky runs at ~0.02 TFLOP/s on TPU and dominated the step).
   3. Implicit feedback uses the Hu-Koren-Volinsky trick: A_row =
      Y^T Y + sum_k alpha*r_k * y_k y_k^T (+ reg), b_row = sum_k
      (1 + alpha*r_k) y_k, so cost scales with observed entries only.
@@ -66,6 +67,17 @@ from predictionio_tpu.ingest import BiMap, RatingColumns
 _BUCKET_BASE = 16
 _BUCKET_GROWTH = 4
 
+# Per-slab transient memory budgets (bytes, f32). A bucket slab of B rows
+# x cap K at rank R materializes a [B, K, R] factor gather and [B, R, R]
+# normal matrices during its solve; unboundedly large buckets (ML-25M has
+# ~150k users in one degree bucket) would blow HBM. Slabs are therefore
+# split so that  B*K*R*4 <= _SLAB_GATHER_BUDGET  and
+# B*R*R*4 <= _SLAB_NORMAL_BUDGET. At rank 10 the caps are ~53M entries /
+# ~1.3M rows (no effect on small problems); at rank 64 they bound the
+# gather to 2 GiB and the normal-equation batch to 512 MiB.
+_SLAB_GATHER_BUDGET = 2 << 30
+_SLAB_NORMAL_BUDGET = 512 << 20
+
 
 @dataclass
 class _SideBuckets:
@@ -88,10 +100,14 @@ def _group_offsets(counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
-               n_rows: int) -> _SideBuckets:
+               n_rows: int, rank: Optional[int] = None) -> _SideBuckets:
     """Group COO entries by row, then bucket rows by degree into padded
     slabs. Host-side preprocessing, done once per training run — fully
-    vectorized (no per-row Python) so ML-25M-scale packing stays cheap."""
+    vectorized (no per-row Python) so ML-25M-scale packing stays cheap.
+
+    When `rank` is given, oversized buckets are split into row chunks so
+    each slab's solve-time transients ([B, cap, rank] gather and
+    [B, rank, rank] normal matrices) stay inside the module budgets."""
     order = np.argsort(row_ix, kind="stable")
     r, c, v = row_ix[order], col_ix[order], val[order]
     uniq, starts, counts = np.unique(r, return_index=True, return_counts=True)
@@ -117,11 +133,63 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
         idx[member_of, intra] = c[src]
         vals[member_of, intra] = v[src]
         msk[member_of, intra] = 1.0
-        out.rows.append(rows)
-        out.idx.append(idx)
-        out.val.append(vals)
-        out.msk.append(msk)
+        if rank is None:
+            chunk = nb
+        else:
+            chunk = max(1, min(_SLAB_NORMAL_BUDGET // (rank * rank * 4),
+                               _SLAB_GATHER_BUDGET // (int(cap) * rank * 4)))
+        for s in range(0, nb, max(chunk, 1)):
+            e = min(s + chunk, nb)
+            out.rows.append(rows[s:e])
+            out.idx.append(idx[s:e])
+            out.val.append(vals[s:e])
+            out.msk.append(msk[s:e])
     return out
+
+
+@dataclass
+class PackedRatings:
+    """Degree-bucketed padded slabs for both sides of a rating matrix —
+    the reusable output of `pack_ratings` (pack once, train many times:
+    eval sweeps, repeated benches)."""
+    user_side: _SideBuckets
+    item_side: _SideBuckets
+    n_users: int
+    n_items: int
+    rank: int
+
+
+def pack_ratings(u_ix: np.ndarray, i_ix: np.ndarray, val: np.ndarray,
+                 n_users: int, n_items: int, rank: int) -> PackedRatings:
+    """Host-side packing of COO ratings into solver slabs for both
+    alternation sides, with rank-aware memory-budget slab splitting."""
+    return PackedRatings(
+        user_side=_pack_side(u_ix, i_ix, val, n_users, rank),
+        item_side=_pack_side(i_ix, u_ix, val, n_items, rank),
+        n_users=n_users, n_items=n_items, rank=rank)
+
+
+def iteration_flops(packed: PackedRatings) -> int:
+    """Closed-form FLOPs of ONE full ALS iteration (both half-steps) over
+    the PADDED slab shapes — the denominator work for achieved-FLOP/s /
+    MFU accounting, counting the work that actually EXECUTES. Convention:
+    multiply-add = 2 FLOPs. Per slab of B rows x cap K at rank R:
+      Gram einsum  bkr,bks,bk->brs : 2*B*K*R^2
+      rhs einsum   bkr,bk->br      : 2*B*K*R
+      PCG solve (`_solve_bucket` runs min(32, R+8) iterations, each one
+      [R,R] matvec + ~4 R-vector ops): B*iters*(2*R^2 + 8*R)
+    (CG executes ~4x the FLOPs of the direct Cholesky it replaced —
+    2*(R^3/3 + 2R^2) per row — but in batched-matmul form; masking
+    elementwise multiplies counted as free.)"""
+    r = packed.rank
+    solve_iters = min(32, r + 8)
+    total = 0
+    for side in (packed.user_side, packed.item_side):
+        for idx in side.idx:
+            b, k = idx.shape
+            total += 2 * b * k * r * r + 2 * b * k * r
+            total += b * solve_iters * (2 * r * r + 8 * r)
+    return total
 
 
 @partial(jax.jit, static_argnames=("implicit",))
@@ -132,9 +200,17 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     idx/val/msk: [rows_b, cap_b]
     yty: [rank, rank] Gram matrix of opposite factors (implicit only)
     Returns [rows_b, rank] solutions.
+
+    The per-row SPD systems are solved with Jacobi-preconditioned CG
+    (`ops.linalg.pcg_solve`): on TPU, XLA's batched Cholesky runs at
+    ~0.02 TFLOP/s and was the single largest cost of the ML-25M training
+    step, while CG is a handful of batched einsums. ALS-WR
+    regularization keeps the systems well-conditioned; oracle-parity
+    tests gate the accuracy.
     """
     import jax.numpy as jnp
-    from jax.scipy.linalg import cho_factor, cho_solve
+
+    from predictionio_tpu.ops.linalg import pcg_solve
 
     rank = factors.shape[1]
     yg = factors[idx]                                   # [B, K, R] gather
@@ -153,8 +229,7 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     a = a + (reg * n_row)[:, None, None] * eye
     # pad rows (n_row == 0) get an identity system -> solution 0
     a = jnp.where((n_row > 0)[:, None, None], a, eye)
-    cf = cho_factor(a, lower=True)
-    x = cho_solve(cf, b)
+    x = pcg_solve(a, b, iters=min(32, rank + 8))
     return jnp.where((n_row > 0)[:, None], x, 0.0)
 
 
@@ -330,25 +405,39 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
               implicit: bool = False,
               alpha: float = 1.0,
               seed: int = 0,
-              mesh=None) -> Tuple[np.ndarray, np.ndarray]:
+              mesh=None,
+              packed: Optional[PackedRatings] = None,
+              timings: Optional[dict] = None) -> Tuple[np.ndarray, np.ndarray]:
     """Train factor matrices (X [n_users, rank], Y [n_items, rank]).
 
     Matches MLlib semantics: ALS-WR regularization (lambda scaled by the
     row's rating count), random normalized init, `iterations` full
     alternations. `mesh` shards each slab's row dimension over the "data"
-    axis; None runs single-device.
+    axis; None runs single-device. `packed` (from `pack_ratings`) skips
+    host-side packing; `timings`, if given, is filled with pack_s /
+    solve_s / fetch_s wall-clock phases (solve_s blocks on the device
+    result, so on a warm compile cache it is pure execution time).
     """
+    import time as _time
+
     import jax.numpy as jnp
 
-    if isinstance(ratings, RatingColumns):
-        u_ix, i_ix, val = ratings.user_ix, ratings.item_ix, ratings.rating
-        n_users = n_users or len(ratings.users)
-        n_items = n_items or len(ratings.items)
+    t0 = _time.perf_counter()
+    if packed is not None:
+        user_side, item_side = packed.user_side, packed.item_side
+        n_users, n_items = packed.n_users, packed.n_items
+        assert packed.rank == rank, "packed slabs were split for a different rank"
     else:
-        u_ix, i_ix, val = ratings
-        assert n_users is not None and n_items is not None
-    user_side = _pack_side(u_ix, i_ix, val, n_users)
-    item_side = _pack_side(i_ix, u_ix, val, n_items)
+        if isinstance(ratings, RatingColumns):
+            u_ix, i_ix, val = ratings.user_ix, ratings.item_ix, ratings.rating
+            n_users = n_users or len(ratings.users)
+            n_items = n_items or len(ratings.items)
+        else:
+            u_ix, i_ix, val = ratings
+            assert n_users is not None and n_items is not None
+        user_side = _pack_side(u_ix, i_ix, val, n_users, rank)
+        item_side = _pack_side(i_ix, u_ix, val, n_items, rank)
+    t_pack = _time.perf_counter()
 
     def present_mask(side, n_rows):
         present = np.zeros(max(n_rows, 1), bool)
@@ -366,7 +455,13 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
             x, y, user_side, item_side, n_users, n_items, mesh,
             reg=reg, alpha=alpha, iterations=iterations,
             implicit=implicit, rank=rank)
-        return (np.asarray(x_sh)[:n_users], np.asarray(y_sh)[:n_items])
+        jax.block_until_ready((x_sh, y_sh))
+        t_solve = _time.perf_counter()
+        out = (np.asarray(x_sh)[:n_users], np.asarray(y_sh)[:n_items])
+        if timings is not None:
+            timings.update(pack_s=t_pack - t0, solve_s=t_solve - t_pack,
+                           fetch_s=_time.perf_counter() - t_solve)
+        return out
 
     dev_sides = []
     for side in (user_side, item_side):
@@ -376,11 +471,20 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
             slabs.append((jnp.asarray(rows), jnp.asarray(idx),
                           jnp.asarray(vals), jnp.asarray(msk)))
         dev_sides.append(slabs)
+    jax.block_until_ready(dev_sides)
+    t_xfer = _time.perf_counter()
 
     x, y = _run_als(x, y, dev_sides[0], dev_sides[1], jnp.float32(reg),
                     jnp.float32(alpha), jnp.int32(iterations),
                     implicit=implicit, rank=rank)
-    return np.asarray(x), np.asarray(y)
+    jax.block_until_ready((x, y))
+    t_solve = _time.perf_counter()
+    out = (np.asarray(x), np.asarray(y))
+    if timings is not None:
+        timings.update(pack_s=t_pack - t0, transfer_s=t_xfer - t_pack,
+                       solve_s=t_solve - t_xfer,
+                       fetch_s=_time.perf_counter() - t_solve)
+    return out
 
 
 def rmse(x: np.ndarray, y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
@@ -404,9 +508,13 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     padding from `_pack_by_owner` equalizing per-device row counts
     (contiguous id blocks; ~1 for hashed/uniform ids, worst case
     n_devices for fully skewed ownership). `peak` is persistent + the
-    worst transient (all-gathered opposite factors plus the gathered slab
-    factors [rows_b, cap_b, rank] for the device's share of the heavier
-    padded side)."""
+    worst transient: all-gathered opposite factors, plus the per-slab
+    solve transients — the [B, cap, rank] factor gather and ~3x
+    [B, rank, rank] normal-equation buffers (A, its Cholesky factor, and
+    an intermediate), each capped by the slab-split budgets
+    (`_SLAB_GATHER_BUDGET` / `_SLAB_NORMAL_BUDGET`), since `_pack_side`
+    splits any bucket whose transients would exceed them and XLA's buffer
+    assignment reuses the previous slab's buffers."""
     fb = 4  # f32 / int32 bytes
     padded_user = _BUCKET_BASE * n_users + _BUCKET_GROWTH * n_ratings
     padded_item = _BUCKET_BASE * n_items + _BUCKET_GROWTH * n_ratings
@@ -415,13 +523,18 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     slabs_local = ((padded_user + padded_item) * 3 * fb / n_devices
                    * owner_skew)
     gathered_opposite = max(n_users, n_items) * rank * fb
-    slab_gather = (max(padded_user, padded_item) * rank * fb / n_devices
-                   * owner_skew)
+    slab_gather = min(
+        max(padded_user, padded_item) * rank * fb / n_devices * owner_skew,
+        _SLAB_GATHER_BUDGET)
+    normal_bufs = 3 * min(
+        max(n_users, n_items) * rank * rank * fb / n_devices * owner_skew,
+        _SLAB_NORMAL_BUDGET)
     persistent = factors_local + slabs_local
+    transient = gathered_opposite + slab_gather + normal_bufs
     return {
         "persistent": persistent,
-        "transient": gathered_opposite + slab_gather,
-        "peak": persistent + gathered_opposite + slab_gather,
+        "transient": transient,
+        "peak": persistent + transient,
     }
 
 

@@ -217,6 +217,24 @@ class PredictionServer(HTTPServerBase):
         with self._dep_lock:
             self._dep = _Deployment(engine, instance, algos, models, serving)
 
+    def start(self, background: bool = True) -> int:
+        """Deploy first undeploys any server squatting on the target port
+        (CreateServer.scala:347-357: the MasterActor sends StopServer to
+        the existing actor before binding); the base class then binds
+        with 3 retries to cover the port-release lag."""
+        if self.port:
+            from predictionio_tpu.cli.ops import undeploy
+            host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
+            try:
+                undeploy(host, self.port,
+                         access_key=self.config.server_key)
+            except Exception:
+                # a key-protected squatter with a different key (or a
+                # non-pio process): the bind retry below will surface
+                # EADDRINUSE if it doesn't go away
+                pass
+        return super().start(background)
+
     # -- serving -------------------------------------------------------------
     def _serve_one(self, query_json: Any) -> Any:
         t0 = time.perf_counter()

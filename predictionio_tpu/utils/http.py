@@ -18,6 +18,7 @@ import json
 import re
 import ssl as ssl_module
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -155,6 +156,7 @@ class HTTPServerBase:
         self._ssl_context = ssl_context
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._lifecycle_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> int:
@@ -200,7 +202,20 @@ class HTTPServerBase:
         # (ECONNRESET) under concurrent client bursts
         _Server = type("_Server", (ThreadingHTTPServer,),
                        {"request_queue_size": 128})
-        self._httpd = _Server((self.host, self.port), _Handler)
+        # 3-attempt bind with backoff (the reference retries Http.Bind
+        # three times before giving up, CreateServer.scala:260-285) —
+        # covers the port-release lag after stopping a previous server.
+        # Only EADDRINUSE is transient; EACCES/EADDRNOTAVAIL etc. can
+        # never succeed and raise immediately.
+        import errno
+        for attempt in range(3):
+            try:
+                self._httpd = _Server((self.host, self.port), _Handler)
+                break
+            except OSError as e:
+                if attempt == 2 or e.errno != errno.EADDRINUSE:
+                    raise
+                time.sleep(0.5 * (attempt + 1))
         if self._ssl_context is not None:
             self._httpd.socket = self._ssl_context.wrap_socket(
                 self._httpd.socket, server_side=True)
@@ -214,13 +229,16 @@ class HTTPServerBase:
         return self.port
 
     def shutdown(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        # idempotent + thread-safe: the /stop handler thread and a caller
+        # (test teardown, signal handler) may race into shutdown
+        with self._lifecycle_lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
 
     def is_running(self) -> bool:
         return self._httpd is not None

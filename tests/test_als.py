@@ -198,6 +198,56 @@ class TestTraining:
                 > scores[:, 3:].mean(axis=1) + 0.3).all()
 
 
+class TestSlabSplitting:
+    """Memory-budget slab splitting (`_SLAB_*_BUDGET`): oversized degree
+    buckets are split into row chunks so the ML-25M rank-64 transients
+    stay bounded; split and unsplit training must agree exactly."""
+
+    def test_split_slabs_match_unsplit_training(self, monkeypatch):
+        u, i, v = synthetic(50, 40, 3, density=0.5, seed=9)
+        x0, y0 = als.als_train((u, i, v), 50, 40, rank=4, iterations=3,
+                               reg=0.05, seed=1)
+        # 8 rows per slab at rank 4 -> forces many chunks
+        monkeypatch.setattr(als, "_SLAB_NORMAL_BUDGET", 4 * 4 * 4 * 8)
+        packed = als.pack_ratings(u, i, v, 50, 40, rank=4)
+        unsplit = als._pack_side(u, i, v, 50)
+        assert len(packed.user_side.rows) > len(unsplit.rows)
+        x1, y1 = als.als_train(None, rank=4, iterations=3, reg=0.05,
+                               seed=1, packed=packed)
+        np.testing.assert_allclose(x0, x1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-5)
+
+    def test_split_slabs_match_on_mesh(self, monkeypatch):
+        u, i, v = synthetic(32, 24, 3, density=0.5, seed=11)
+        x0, y0 = als.als_train((u, i, v), 32, 24, rank=4, iterations=2,
+                               reg=0.05, seed=2)
+        monkeypatch.setattr(als, "_SLAB_NORMAL_BUDGET", 4 * 4 * 4 * 4)
+        packed = als.pack_ratings(u, i, v, 32, 24, rank=4)
+        x1, y1 = als.als_train(None, rank=4, iterations=2, reg=0.05,
+                               seed=2, packed=packed, mesh=make_mesh())
+        np.testing.assert_allclose(x0, x1, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(y0, y1, rtol=1e-3, atol=1e-4)
+
+    def test_iteration_flops_counts_padded_work(self):
+        u, i, v = synthetic(20, 15, 2, density=0.5, seed=3)
+        p4 = als.pack_ratings(u, i, v, 20, 15, rank=4)
+        p8 = als.pack_ratings(u, i, v, 20, 15, rank=8)
+        assert als.iteration_flops(p4) > 0
+        # Gram term dominates and is quadratic in rank
+        assert als.iteration_flops(p8) > 3 * als.iteration_flops(p4)
+        # padded entries >= real entries
+        padded = sum(ix.size for ix in p4.user_side.idx)
+        assert padded >= len(u)
+
+    def test_timings_dict_is_filled(self):
+        u, i, v = synthetic(20, 15, 2, density=0.5, seed=3)
+        tm = {}
+        als.als_train((u, i, v), 20, 15, rank=4, iterations=1, reg=0.1,
+                      timings=tm)
+        assert set(tm) >= {"pack_s", "solve_s", "fetch_s"}
+        assert all(t >= 0 for t in tm.values())
+
+
 class TestTopK:
     def test_masked_topk_matches_numpy(self):
         rng = np.random.RandomState(0)

@@ -84,6 +84,38 @@ class TestImportExport:
         rows = [json.loads(s) for s in out.read_text().splitlines()]
         assert {r["entityId"] for r in rows} == {f"u{i}" for i in range(5)}
 
+    def test_parquet_roundtrip(self, mem_registry, tmp_path):
+        """export -> parquet -> import into a second app reproduces the
+        events (EventsToFile.scala:40-108 text|parquet parity)."""
+        pytest.importorskip("pyarrow")
+        info = ops.app_new(mem_registry, "pq1")
+        store = mem_registry.get_events()
+        for i in range(7):
+            store.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{i}",
+                target_entity_type="item", target_entity_id="i1",
+                properties=DataMap({"rating": float(i), "tags": ["a", "b"]})),
+                info["id"])
+        out = tmp_path / "events.parquet"
+        n = ops.export_events(mem_registry, app_id=info["id"],
+                              output_path=str(out), format="parquet")
+        assert n == 7
+        info2 = ops.app_new(mem_registry, "pq2")
+        n2 = ops.import_events(mem_registry, app_id=info2["id"],
+                               input_path=str(out), format="parquet")
+        assert n2 == 7
+        back = sorted(store.find(info2["id"]), key=lambda e: e.entity_id)
+        assert [e.entity_id for e in back] == [f"u{i}" for i in range(7)]
+        assert back[3].properties.get("rating") == 3.0
+        assert back[3].properties.get("tags") == ["a", "b"]
+        assert back[3].target_entity_id == "i1"
+
+    def test_unknown_format_rejected(self, mem_registry, tmp_path):
+        info = ops.app_new(mem_registry, "pq3")
+        with pytest.raises(ValueError, match="Unknown export format"):
+            ops.export_events(mem_registry, app_id=info["id"],
+                              output_path=str(tmp_path / "x"), format="csv")
+
 
 class TestStatus:
     def test_status(self, mem_registry):
@@ -213,3 +245,70 @@ class TestQuickstartSubprocess:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+@pytest.mark.slow
+class TestServiceOps:
+    """start-all / stop-all / daemon with pidfiles (bin/pio-start-all,
+    bin/pio-stop-all, bin/pio-daemon analogs)."""
+
+    def run_cli(self, args, cwd, env):
+        return subprocess.run(
+            [sys.executable, "-m", "predictionio_tpu.cli", *args],
+            cwd=cwd, env=env, capture_output=True, text=True, timeout=120)
+
+    def test_start_all_stop_all(self, tmp_path):
+        import socket
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(
+            os.environ,
+            PYTHONPATH=repo,
+            JAX_PLATFORMS="cpu",
+            PIO_STORAGE_SOURCES_PIO_TYPE="SQLITE",
+            PIO_STORAGE_SOURCES_PIO_PATH=str(tmp_path / "pio.db"),
+        )
+        cwd = str(tmp_path)
+        ports = []
+        socks = []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        pid_dir = str(tmp_path / "run")
+        try:
+            r = self.run_cli(
+                ["start-all", "--ip", "127.0.0.1",
+                 "--event-server-port", str(ports[0]),
+                 "--dashboard-port", str(ports[1]),
+                 "--admin-port", str(ports[2]),
+                 "--pid-dir", pid_dir,
+                 "--log-dir", str(tmp_path / "log")], cwd, env)
+            assert r.returncode == 0, r.stderr + r.stdout
+            started = json.loads(r.stdout)
+            assert {s["name"] for s in started} == {
+                "eventserver", "dashboard", "adminserver"}
+            assert all(s["status"] == "up" for s in started)
+            # pidfiles exist and all three answer HTTP
+            assert len(list((tmp_path / "run").glob("pio-*.pid"))) == 3
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[0]}/", timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            r = self.run_cli(["stop-all", "--pid-dir", pid_dir], cwd, env)
+        assert r.returncode == 0, r.stderr
+        stopped = json.loads(r.stdout)
+        assert {s["name"] for s in stopped} == {
+            "eventserver", "dashboard", "adminserver"}
+        assert all(s["status"] == "stopped" for s in stopped)
+        assert not list((tmp_path / "run").glob("pio-*.pid"))
+        # ports released (SO_REUSEADDR: sockets may linger in TIME_WAIT)
+        time.sleep(0.2)
+        for port in ports:
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+            s.close()

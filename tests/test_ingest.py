@@ -159,3 +159,46 @@ class TestMesh:
         assert dev["rating"].shape == (8,)
         # padded tail rows must be neutral (rating 0)
         assert float(np.asarray(dev["rating"]).sum()) == 5.0
+
+
+class TestEntityMap:
+    """Typed entity collection (`data/.../storage/EntityMap.scala`)."""
+
+    def test_apply_get_contains_ix(self):
+        from predictionio_tpu.data.entitymap import EntityMap
+
+        em = EntityMap({"a": 1, "b": 2, "c": 3})
+        assert em("b") == 2
+        assert em.get("zz") is None and em.get("zz", 9) == 9
+        assert "c" in em and "zz" not in em
+        assert len(em) == 3
+        with pytest.raises(KeyError):
+            em("zz")
+        # dense indexes in first-seen order, invertible
+        assert em.id_to_ix("a") == 0 and em.id_to_ix.ix_to_id(2) == "c"
+        assert em.by_ix(1) == 2
+
+    def test_map_values_shares_index(self):
+        from predictionio_tpu.data.entitymap import EntityMap
+
+        em = EntityMap({"x": 2, "y": 5})
+        doubled = em.map_values(lambda v: v * 10)
+        assert doubled("y") == 50
+        assert doubled.id_to_ix is em.id_to_ix
+
+    def test_from_aggregated_properties(self, mem_registry):
+        from predictionio_tpu.data.entitymap import (
+            entity_map_from_properties,
+        )
+        from predictionio_tpu.data.storage import App
+
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "emapp"))
+        store = mem_registry.get_events()
+        store.init(app_id)
+        for uid, age in (("u1", 20), ("u2", 30)):
+            store.insert(ev("$set", uid, props={"age": age}), app_id)
+        em = entity_map_from_properties(
+            mem_registry, "emapp", entity_type="user",
+            extract=lambda pm: pm.get("age"))
+        assert len(em) == 2 and em("u2") == 30
+        assert em.id_to_ix.get("u1") is not None

@@ -275,6 +275,39 @@ class TestServerKeyAuth:
             srv.shutdown()
 
 
+class TestDeployTwiceOnOnePort:
+    def test_second_deploy_undeploys_squatter(self, trained):
+        """Deploying on an occupied port first stops the squatting server
+        (CreateServer.scala:347-357) and then binds with retry
+        (CreateServer.scala:260-285)."""
+        import socket
+
+        registry, engine, _, _ = trained
+        # grab an ephemeral port number, then release it for the servers
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv1 = PredictionServer(ServerConfig(ip="127.0.0.1", port=port),
+                                registry=registry, engine=engine)
+        srv1.start()
+        srv2 = PredictionServer(ServerConfig(ip="127.0.0.1", port=port),
+                                registry=registry, engine=engine)
+        try:
+            srv2.start()
+            assert srv2.port == port
+            status, body = call(port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200 and body["itemScores"]
+            deadline = time.time() + 5
+            while srv1.is_running() and time.time() < deadline:
+                time.sleep(0.05)
+            assert not srv1.is_running()
+        finally:
+            srv2.shutdown()
+            if srv1.is_running():
+                srv1.shutdown()
+
+
 class TestConcurrencyHardening:
     def test_request_count_exact_under_hammer(self, trained):
         """Latency counters are locked: N concurrent requests must count
