@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--skip-sanity-check", action="store_true")
     x.add_argument("--stop-after-read", action="store_true")
     x.add_argument("--stop-after-prepare", action="store_true")
+    x.add_argument("--coordinator",
+                   help="host:port of process 0 for multi-host training "
+                        "(jax.distributed); or set PIO_TPU_COORDINATOR")
+    x.add_argument("--num-processes", type=int)
+    x.add_argument("--process-id", type=int)
     x = sub.add_parser("eval")
     x.add_argument("evaluation", help="dotted path to an Evaluation")
     x.add_argument("params_generator", nargs="?",
@@ -207,7 +212,10 @@ def main(argv: Optional[list] = None) -> int:
                 engine_factory=args.engine_factory, batch=args.batch,
                 mesh=args.mesh, skip_sanity_check=args.skip_sanity_check,
                 stop_after_read=args.stop_after_read,
-                stop_after_prepare=args.stop_after_prepare))
+                stop_after_prepare=args.stop_after_prepare,
+                coordinator=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id))
             return 0
         if cmd == "eval":
             _emit(ops.run_eval(_registry(), args.evaluation,

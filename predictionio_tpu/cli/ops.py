@@ -180,10 +180,28 @@ def train(registry, *, engine_json: str = "engine.json",
           batch: str = "", mesh: Optional[str] = None,
           skip_sanity_check: bool = False,
           stop_after_read: bool = False,
-          stop_after_prepare: bool = False) -> Dict[str, Any]:
-    """pio train (commands/Engine.scala:177-188 -> CreateWorkflow)."""
+          stop_after_prepare: bool = False,
+          coordinator: Optional[str] = None,
+          num_processes: Optional[int] = None,
+          process_id: Optional[int] = None) -> Dict[str, Any]:
+    """pio train (commands/Engine.scala:177-188 -> CreateWorkflow).
+
+    Multi-host: `--coordinator host:port --num-processes N --process-id K`
+    (or the PIO_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID env vars)
+    initializes `jax.distributed` before the mesh is built; every process
+    runs the sharded training computation, but only process 0 writes
+    metadata and the model blob (the env-forwarding spark-submit analog,
+    Runner.scala:213-215,298-305)."""
     from predictionio_tpu.core import RuntimeContext, WorkflowParams
     from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
+    from predictionio_tpu.parallel import initialize_distributed
+
+    # flags override env inside initialize_distributed; nothing is
+    # written back to os.environ (a later single-host train in the same
+    # process must not inherit coordinator state)
+    distributed = initialize_distributed(
+        coordinator=coordinator, num_processes=num_processes,
+        process_id=process_id)
 
     variant = load_variant(engine_json)
     factory = resolve_factory_name(variant, engine_factory, engine_json)
@@ -199,13 +217,19 @@ def train(registry, *, engine_json: str = "engine.json",
             stop_after_read=stop_after_read,
             stop_after_prepare=stop_after_prepare,
             runtime_conf=runtime_conf))
+    persist = True
+    if distributed:
+        import jax
+        persist = jax.process_index() == 0
     row = CoreWorkflow.run_train(
         engine, engine_params, ctx,
         engine_factory=factory,
-        engine_variant=variant.get("id", "default"))
+        engine_variant=variant.get("id", "default"),
+        persist=persist)
     return {"engineInstanceId": row.id, "status": row.status,
             "startTime": format_time(row.start_time),
-            "endTime": format_time(row.end_time)}
+            "endTime": format_time(row.end_time),
+            "distributed": distributed, "persisted": persist}
 
 
 def run_eval(registry, evaluation_path: str,

@@ -59,50 +59,60 @@ class Metric:
         return key if self.higher_is_better else -key
 
 
-class AverageMetric(Metric):
+class _BatchableMetric(Metric):
+    """Shared machinery for the calculate_one family: a metric may
+    override `calculate_batch` to score a whole fold's (Q,P,A) list as
+    one array op (numpy / device arrays) instead of a Python loop per
+    tuple — SURVEY.md §7.6 'Metric hierarchy over device arrays'. Large
+    k-fold x param-grid sweeps are otherwise CPU-bound on tuple
+    iteration. Returning None falls back to per-tuple calculate_one."""
+
+    def calculate_batch(self, qpa: List[Tuple[Any, Any, Any]]):
+        """Override: return an array-like of per-tuple scores for one
+        fold (None entries allowed for OptionAverageMetric), or None to
+        use the calculate_one fallback."""
+        return None
+
+    def _fold_scores(self, qpa) -> List:
+        batch = self.calculate_batch(qpa)
+        if batch is not None:
+            return list(batch)
+        return [self.calculate_one(q, p, a) for q, p, a in qpa]
+
+    def calculate_one(self, q, p, a):
+        raise NotImplementedError
+
+
+class AverageMetric(_BatchableMetric):
     """Mean of calculate_one over every (Q,P,A) (Metric.scala:95-130)."""
 
-    def calculate_one(self, q, p, a) -> float:
-        raise NotImplementedError
-
     def calculate(self, ctx, eval_data):
-        scores = [self.calculate_one(q, p, a)
-                  for _, qpa in eval_data for q, p, a in qpa]
+        scores = [s for _, qpa in eval_data for s in self._fold_scores(qpa)]
         return float(sum(scores) / len(scores)) if scores else float("nan")
 
 
-class OptionAverageMetric(Metric):
+class OptionAverageMetric(_BatchableMetric):
     """Mean over non-None scores only (Metric.scala:132-170)."""
 
-    def calculate_one(self, q, p, a) -> Optional[float]:
-        raise NotImplementedError
-
     def calculate(self, ctx, eval_data):
-        scores = [s for _, qpa in eval_data for q, p, a in qpa
-                  if (s := self.calculate_one(q, p, a)) is not None]
+        scores = [s for _, qpa in eval_data for s in self._fold_scores(qpa)
+                  if s is not None]
         return float(sum(scores) / len(scores)) if scores else float("nan")
 
 
-class SumMetric(Metric):
+class SumMetric(_BatchableMetric):
     """Sum of calculate_one (Metric.scala:217-250)."""
 
-    def calculate_one(self, q, p, a) -> float:
-        raise NotImplementedError
-
     def calculate(self, ctx, eval_data):
-        return float(sum(self.calculate_one(q, p, a)
-                         for _, qpa in eval_data for q, p, a in qpa))
+        return float(sum(s for _, qpa in eval_data
+                         for s in self._fold_scores(qpa)))
 
 
-class StdevMetric(Metric):
+class StdevMetric(_BatchableMetric):
     """Population stdev of calculate_one (Metric.scala:172-215)."""
 
-    def calculate_one(self, q, p, a) -> float:
-        raise NotImplementedError
-
     def calculate(self, ctx, eval_data):
-        scores = [self.calculate_one(q, p, a)
-                  for _, qpa in eval_data for q, p, a in qpa]
+        scores = [s for _, qpa in eval_data for s in self._fold_scores(qpa)]
         if not scores:
             return float("nan")
         mean = sum(scores) / len(scores)

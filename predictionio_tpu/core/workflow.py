@@ -76,12 +76,27 @@ class CoreWorkflow:
                   ctx: RuntimeContext, *,
                   engine_factory: str = "",
                   engine_variant: str = "",
-                  verbose_save: bool = True) -> EngineInstance:
+                  verbose_save: bool = True,
+                  persist: bool = True) -> EngineInstance:
         """Train, persist models, record the instance
         (CoreWorkflow.scala:45-101): insert INIT row, train, serialize
         models into the model repo, update status to COMPLETED; any failure
         leaves the row non-COMPLETED so deploy refuses it
-        (commands/Engine.scala:235-236)."""
+        (commands/Engine.scala:235-236).
+
+        `persist=False` runs the training computation but touches no
+        storage — the non-coordinator processes of a multi-host run use
+        it: they must participate in every collective, while only
+        process 0 owns the metadata/model writes (the analog of Spark
+        executors computing while the driver alone talks to storage)."""
+        if not persist:
+            engine.train(ctx, engine_params)
+            return EngineInstance(
+                id="", status=EngineInstanceStatus.COMPLETED,
+                start_time=utcnow(), end_time=utcnow(),
+                engine_id="default", engine_version="default",
+                engine_variant=engine_variant or "default",
+                engine_factory=engine_factory)
         registry = ctx.registry
         instances = registry.get_meta_data_engine_instances()
         row = EngineInstance(

@@ -125,7 +125,8 @@ class NaiveBayesAlgorithm(Algorithm):
 
     def train(self, ctx: RuntimeContext,
               pd: LabeledPoints) -> nb_ops.NaiveBayesModel:
-        return nb_ops.nb_train(pd.features, pd.label, self.params.lambda_)
+        return nb_ops.nb_train(pd.features, pd.label, self.params.lambda_,
+                               mesh=ctx.mesh)
 
     def predict(self, model, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
@@ -152,7 +153,7 @@ class LogisticRegressionAlgorithm(Algorithm):
               pd: LabeledPoints) -> lr_ops.LogRegModel:
         p = self.params
         return lr_ops.logreg_train(pd.features, pd.label, steps=p.steps,
-                                   lr=p.lr, reg=p.reg)
+                                   lr=p.lr, reg=p.reg, mesh=ctx.mesh)
 
     def predict(self, model, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
@@ -187,7 +188,8 @@ class RandomForestAlgorithm(Algorithm):
             pd.features, pd.label, n_trees=p.num_trees,
             max_depth=p.max_depth, max_bins=p.max_bins,
             impurity=p.impurity,
-            feature_subset_strategy=p.feature_subset_strategy, seed=p.seed)
+            feature_subset_strategy=p.feature_subset_strategy, seed=p.seed,
+            mesh=ctx.mesh)
 
     def predict(self, model, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
@@ -201,7 +203,15 @@ class RandomForestAlgorithm(Algorithm):
 
 class Accuracy(AverageMetric):
     """Fraction of correct predictions (the template's Precision
-    evaluation generalized to all classes)."""
+    evaluation generalized to all classes). Batch-vectorized: a fold is
+    scored as one array comparison instead of a Python loop per (Q,P,A)
+    tuple (SURVEY.md §7.6)."""
+
+    def calculate_batch(self, qpa):
+        n = len(qpa)
+        pred = np.fromiter((p.label for _, p, _ in qpa), np.float64, n)
+        act = np.fromiter((a.label for _, _, a in qpa), np.float64, n)
+        return (pred == act).astype(np.float64)
 
     def calculate_one(self, q, p: PredictedResult, a: ActualResult) -> float:
         return 1.0 if p.label == a.label else 0.0

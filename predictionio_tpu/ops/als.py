@@ -457,7 +457,19 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
             implicit=implicit, rank=rank)
         jax.block_until_ready((x_sh, y_sh))
         t_solve = _time.perf_counter()
-        out = (np.asarray(x_sh)[:n_users], np.asarray(y_sh)[:n_items])
+
+        def fetch(arr):
+            # multi-host mesh: shards on other processes are not
+            # addressable here; all-gather across hosts first
+            # (Runner.scala's executors ship results to the driver —
+            # here every host ends with the full factors)
+            if arr.is_fully_addressable:
+                return np.asarray(arr)
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True))
+
+        out = (fetch(x_sh)[:n_users], fetch(y_sh)[:n_items])
         if timings is not None:
             timings.update(pack_s=t_pack - t0, solve_s=t_solve - t_pack,
                            fetch_s=_time.perf_counter() - t_solve)

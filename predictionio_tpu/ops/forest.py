@@ -13,9 +13,11 @@ per-node control flow:
      (the `maxBins` analog; split candidates = bin boundaries).
   2. All trees grow together. The class histogram
      `hist[tree, node, feature, bin, class]` for a level is built by one
-     batched scatter-add of precomputed one-hot feature-bin rows
-     `[n, f*B]` keyed by the sample's (node, class) — no `[t, n, nd*C]`
-     intermediate ever materializes.
+     weight scatter-add keyed by (node*C + class, feature, bin) — the
+     per-sample transients are the int32 key matrix `[n, f]`, the same
+     size as the binned features themselves, so memory scales O(n*f)
+     (a 1M x 100-feature train at 32 bins peaks well under 1 GB where a
+     dense one-hot formulation would need 12.8 GB).
   3. Split selection is a vectorized argmax of impurity gain (gini or
      entropy) over `[f x B]` candidates per (tree, node), under a random
      per-node feature-subset mask (`featureSubsetStrategy`).
@@ -26,6 +28,14 @@ per-node control flow:
 
 Bagging matches MLlib: Poisson(1) bootstrap weights per (tree, sample)
 when `n_trees > 1`, no bootstrap for a single tree.
+
+Multi-chip: with a `mesh`, samples are block-sharded over the "data"
+axis; each device scatter-adds a partial histogram from its local
+samples and a [t, nd, f, B, C] `psum` over ICI reconstitutes the global
+histogram (MLlib's per-node-group executor aggregation, as one
+collective). Split selection is replicated (tiny), and sample routing to
+child nodes stays local. Agreement with the single-device path is exact
+and tested.
 """
 
 from __future__ import annotations
@@ -82,33 +92,62 @@ def _impurity(counts, total, kind: str):
     raise ValueError(f"Unknown impurity {kind!r}")
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_classes", "n_features",
-                                   "n_bins", "subset", "impurity"))
-def _grow_level(key, fb_rows, node, y, w, xb, *, n_nodes: int,
-                n_classes: int, n_features: int, n_bins: int, subset: int,
-                impurity: str):
-    """One level for every tree at once.
+# transient budget for the histogram scatter keys: the [t, chunk, f]
+# int32 key block (and its weight broadcast) stays under this many bytes,
+# so a 1M x 100 x 10-tree level never materializes the full [t, n*f]
+# index space (which OOMs at ~6 GB x 3 temps on a 16 GiB chip)
+_HIST_KEY_BUDGET = 256 << 20
 
-    fb_rows: [n, f*B] one-hot feature-bin rows (shared across trees)
-    node:    [t, n]   current node of each sample in each tree
-    y:       [n]      class ids
-    w:       [t, n]   bootstrap weights
-    xb:      [n, f]   binned features
-    Returns (split_feature [t, nd], split_bin [t, nd], new node [t, n]).
+
+def _histogram(s, w, fb_cols, *, n_nodes: int, c: int, f: int, b: int):
+    """Partial class histogram from (this device's) samples.
+
+    s:       [t, n]  node*C + class per (tree, sample)
+    w:       [t, n]  bootstrap weights
+    fb_cols: [n, f]  flat feature-bin column f*B + bin
+    Returns [t, nd, f, B, C]. Scatter-adds keyed by (s, feature-bin) —
+    never a dense one-hot. Large sample counts are processed in
+    lax.scan chunks so the [t, chunk, f] key transients respect
+    `_HIST_KEY_BUDGET`.
     """
-    t = node.shape[0]
-    f, b, c = n_features, n_bins, n_classes
+    t, n = s.shape
+    size = n_nodes * c * f * b
 
-    # hist[t, nd*C, f*B] via per-tree scatter-add of fb rows
-    s = node * c + y[None, :]                      # [t, n]
+    def add_block(hist, s_blk, w_blk, fb_blk):
+        def one_tree(h_t, s_t, w_t):
+            keys = s_t[:, None] * (f * b) + fb_blk       # [chunk, f]
+            upd = jnp.broadcast_to(w_t[:, None], keys.shape)
+            return h_t.at[keys.reshape(-1)].add(upd.reshape(-1))
 
-    def one_tree(s_t, w_t):
-        return jnp.zeros((n_nodes * c, f * b), jnp.float32).at[s_t].add(
-            fb_rows * w_t[:, None])
+        return jax.vmap(one_tree)(hist, s_blk, w_blk)
 
-    hist = jax.vmap(one_tree)(s, w)
-    hist = hist.reshape(t, n_nodes, c, f, b).transpose(0, 1, 3, 4, 2)
-    # [t, nd, f, B, C]; threshold "<= bin" -> left counts = cumsum over B
+    chunk = max(1, _HIST_KEY_BUDGET // (max(t, 1) * max(f, 1) * 4))
+    if chunk >= n:
+        hist = add_block(jnp.zeros((t, size), jnp.float32), s, w, fb_cols)
+    else:
+        n_chunks = -(-n // chunk)
+        npad = n_chunks * chunk
+        # pad with weight-0 samples keyed to slot 0 (invisible)
+        s_p = jnp.pad(s, ((0, 0), (0, npad - n)))
+        w_p = jnp.pad(w, ((0, 0), (0, npad - n)))
+        fb_p = jnp.pad(fb_cols, ((0, npad - n), (0, 0)))
+        xs = (s_p.reshape(t, n_chunks, chunk).transpose(1, 0, 2),
+              w_p.reshape(t, n_chunks, chunk).transpose(1, 0, 2),
+              fb_p.reshape(n_chunks, chunk, f))
+
+        def body(hist, blk):
+            return add_block(hist, *blk), None
+
+        hist, _ = jax.lax.scan(body, jnp.zeros((t, size), jnp.float32), xs)
+    return hist.reshape(t, n_nodes, c, f, b).transpose(0, 1, 3, 4, 2)
+
+
+def _select_splits(key, hist, *, n_nodes: int, c: int, f: int, b: int,
+                   subset: int, impurity: str):
+    """Vectorized split selection from the GLOBAL histogram
+    [t, nd, f, B, C]; pure replicated math."""
+    t = hist.shape[0]
+    # threshold "<= bin" -> left counts = cumsum over B
     left = jnp.cumsum(hist, axis=3)
     total = left[:, :, :, -1, :]                   # [t, nd, f, C]
     right = total[:, :, :, None, :] - left
@@ -139,22 +178,82 @@ def _grow_level(key, fb_rows, node, y, w, xb, *, n_nodes: int,
     degenerate = ~(best_gain > 0)
     split_f = jnp.where(degenerate, 0, split_f).astype(jnp.int32)
     split_b = jnp.where(degenerate, b - 1, split_b).astype(jnp.int32)
+    return split_f, split_b
 
+
+def _route(xb, node, split_f, split_b):
+    """Move each (tree, sample) to its child node; purely local."""
+    t = node.shape[0]
     feat_vals = xb[jnp.arange(xb.shape[0])[None, :], split_f[
         jnp.arange(t)[:, None], node]]             # [t, n]
     go_right = feat_vals > split_b[jnp.arange(t)[:, None], node]
-    new_node = node * 2 + go_right.astype(jnp.int32)
-    return split_f, split_b, new_node
+    return node * 2 + go_right.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_classes"))
-def _leaf_counts(node, y, w, *, n_nodes: int, n_classes: int):
-    s = node * n_classes + y[None, :]
+@partial(jax.jit, static_argnames=("n_nodes", "n_classes", "n_features",
+                                   "n_bins", "subset", "impurity", "mesh"))
+def _grow_level(key, fb_cols, node, y, w, xb, *, n_nodes: int,
+                n_classes: int, n_features: int, n_bins: int, subset: int,
+                impurity: str, mesh=None):
+    """One level for every tree at once.
 
-    def one_tree(s_t, w_t):
-        return jnp.zeros((n_nodes * n_classes,), jnp.float32).at[s_t].add(w_t)
+    fb_cols: [n, f]   flat feature-bin columns (shared across trees)
+    node:    [t, n]   current node of each sample in each tree
+    y:       [n]      class ids
+    w:       [t, n]   bootstrap weights
+    xb:      [n, f]   binned features
+    Returns (split_feature [t, nd], split_bin [t, nd], new node [t, n]).
+    With a mesh, the sample dimension is sharded over "data": per-device
+    partial histograms + one psum, replicated split selection, local
+    routing.
+    """
+    f, b, c = n_features, n_bins, n_classes
+    kw = dict(n_nodes=n_nodes, c=c, f=f, b=b)
 
-    return jax.vmap(one_tree)(s, w).reshape(-1, n_nodes, n_classes)
+    def level(key, fb_cols, node, y, w, xb, *, hist_reduce):
+        s = node * c + y[None, :]
+        hist = hist_reduce(_histogram(s, w, fb_cols, **kw))
+        split_f, split_b = _select_splits(
+            key, hist, subset=subset, impurity=impurity, **kw)
+        return split_f, split_b, _route(xb, node, split_f, split_b)
+
+    if mesh is None:
+        return level(key, fb_cols, node, y, w, xb, hist_reduce=lambda h: h)
+
+    from jax.sharding import PartitionSpec as P
+
+    body = partial(level,
+                   hist_reduce=lambda h: jax.lax.psum(h, "data"))
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P("data", None), P(None, "data"), P("data"),
+                  P(None, "data"), P("data", None)),
+        out_specs=(P(), P(), P(None, "data")))(
+            key, fb_cols, node, y, w, xb)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_classes", "mesh"))
+def _leaf_counts(node, y, w, *, n_nodes: int, n_classes: int, mesh=None):
+    def counts(node, y, w, *, reduce):
+        s = node * n_classes + y[None, :]
+
+        def one_tree(s_t, w_t):
+            return jnp.zeros((n_nodes * n_classes,),
+                             jnp.float32).at[s_t].add(w_t)
+
+        return reduce(jax.vmap(one_tree)(s, w)).reshape(
+            -1, n_nodes, n_classes)
+
+    if mesh is None:
+        return counts(node, y, w, reduce=lambda x: x)
+
+    from jax.sharding import PartitionSpec as P
+
+    body = partial(counts, reduce=lambda x: jax.lax.psum(x, "data"))
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "data"), P("data"), P(None, "data")),
+        out_specs=P())(node, y, w)
 
 
 @dataclass
@@ -176,11 +275,25 @@ class ForestModel:
         assert self.split_feature.shape == self.split_bin.shape
         assert self.leaf_class.shape[1] == 2 ** self.max_depth
 
+    # below this many (tree, sample) traversals, host numpy wins (device
+    # dispatch overhead dominates single-query serving); above it, the
+    # jit'd traversal keeps eval sweeps / batchpredict on the device
+    HOST_CROSSOVER_CELLS = 1 << 14
+
     def predict(self, features: np.ndarray) -> np.ndarray:
-        """Majority vote over trees; returns original label values."""
+        """Majority vote over trees; returns original label values.
+        Size-dispatched: big batches run the jit'd device traversal, tiny
+        ones the equivalent host loop. Tie-breaking (lowest class index)
+        is identical on both paths."""
         xb = apply_bins(np.asarray(features, np.float32), self.bin_edges)
-        t = self.n_trees
-        n = xb.shape[0]
+        t, n = self.n_trees, xb.shape[0]
+        c = len(self.classes)
+        if t * n >= self.HOST_CROSSOVER_CELLS:
+            ix = np.asarray(_predict_device(
+                jnp.asarray(xb), jnp.asarray(self.split_feature),
+                jnp.asarray(self.split_bin), jnp.asarray(self.leaf_class),
+                max_depth=self.max_depth, n_classes=c))
+            return self.classes[ix]
         node = np.zeros((t, n), np.int32)
         rows = np.arange(n)[None, :]
         trees = np.arange(t)[:, None]
@@ -190,7 +303,6 @@ class ForestModel:
             sb = self.split_bin[trees, off + node]
             node = node * 2 + (xb[rows, sf] > sb)
         votes = self.leaf_class[trees, node]             # [t, n]
-        c = len(self.classes)
         # per-sample class counts in one bincount: flat id = class*n + col
         counts = np.bincount(
             (votes.astype(np.int64) * n + np.arange(n)).ravel(),
@@ -198,12 +310,34 @@ class ForestModel:
         return self.classes[np.argmax(counts, axis=0)]
 
 
+@partial(jax.jit, static_argnames=("max_depth", "n_classes"))
+def _predict_device(xb, split_feature, split_bin, leaf_class, *,
+                    max_depth: int, n_classes: int):
+    """Device forest traversal: level-unrolled gathers + one-hot vote
+    count; returns class indices [n] (argmax ties -> lowest index, the
+    host path's np.argmax convention)."""
+    t, n = split_feature.shape[0], xb.shape[0]
+    node = jnp.zeros((t, n), jnp.int32)
+    rows = jnp.arange(n)[None, :]
+    trees = jnp.arange(t)[:, None]
+    for level in range(max_depth):
+        off = (1 << level) - 1
+        sf = split_feature[trees, off + node]
+        sb = split_bin[trees, off + node]
+        node = node * 2 + (xb[rows, sf] > sb).astype(jnp.int32)
+    votes = leaf_class[trees, node]                      # [t, n]
+    counts = jax.nn.one_hot(votes, n_classes, dtype=jnp.float32).sum(0)
+    return jnp.argmax(counts, axis=1)
+
+
 def forest_train(features: np.ndarray, labels: np.ndarray, *,
                  n_trees: int = 10, max_depth: int = 5, max_bins: int = 32,
                  impurity: str = "gini",
                  feature_subset_strategy: str = "auto",
-                 seed: int = 0) -> ForestModel:
-    """Train a random forest on dense features [n, f] and labels [n]."""
+                 seed: int = 0, mesh=None) -> ForestModel:
+    """Train a random forest on dense features [n, f] and labels [n].
+    `mesh` shards the sample dimension over the "data" axis (partial
+    histograms + psum); None runs single-device."""
     features = np.asarray(features, np.float32)
     labels = np.asarray(labels)
     classes, y_np = np.unique(labels, return_inverse=True)
@@ -220,12 +354,22 @@ def forest_train(features: np.ndarray, labels: np.ndarray, *,
     else:
         w = jax.random.poisson(kboot, 1.0, (n_trees, n)).astype(jnp.float32)
 
-    # one-hot feature-bin rows [n, f*B], shared by every tree and level;
-    # built by scatter (a dense one_hot would materialize [n, f, f*B])
-    fb_cols = xb_np + np.arange(f)[None, :] * max_bins
-    fb_rows = jnp.zeros((n, f * max_bins), jnp.float32).at[
-        jnp.arange(n)[:, None], jnp.asarray(fb_cols)].set(1.0)
-    y = jnp.asarray(y_np.astype(np.int32))
+    fb_cols_np = xb_np + np.arange(f)[None, :] * max_bins
+    y_np32 = y_np.astype(np.int32)
+    if mesh is not None:
+        # pad samples to a device multiple with weight-0 rows (invisible
+        # to every histogram) and shard the sample dimension
+        from predictionio_tpu.parallel import pad_rows, pad_to_multiple
+
+        n_dev = int(mesh.shape["data"])
+        npad = pad_to_multiple(max(n, n_dev), n_dev)
+        fb_cols_np = pad_rows(fb_cols_np, npad)
+        xb_np = pad_rows(xb_np, npad)
+        y_np32 = pad_rows(y_np32, npad)
+        w = jnp.pad(w, ((0, 0), (0, npad - n)))
+        n = npad
+    fb_cols = jnp.asarray(fb_cols_np)
+    y = jnp.asarray(y_np32)
     xb = jnp.asarray(xb_np)
     node = jnp.zeros((n_trees, n), jnp.int32)
 
@@ -233,16 +377,19 @@ def forest_train(features: np.ndarray, labels: np.ndarray, *,
     for level in range(max_depth):
         key, klevel = jax.random.split(key)
         sf, sb, node = _grow_level(
-            klevel, fb_rows, node, y, w, xb, n_nodes=1 << level,
+            klevel, fb_cols, node, y, w, xb, n_nodes=1 << level,
             n_classes=c, n_features=f, n_bins=max_bins, subset=subset,
-            impurity=impurity)
+            impurity=impurity, mesh=mesh)
         split_fs.append(np.asarray(sf))
         split_bs.append(np.asarray(sb))
 
-    counts = _leaf_counts(node, y, w, n_nodes=1 << max_depth, n_classes=c)
+    counts = _leaf_counts(node, y, w, n_nodes=1 << max_depth, n_classes=c,
+                          mesh=mesh)
     # empty leaves (never reached in training) fall back to the global
-    # class distribution
-    global_counts = jnp.bincount(y, length=c).astype(jnp.float32)
+    # class distribution — computed from the ORIGINAL labels (the mesh
+    # path pads y with class-0 rows, which must not skew the fallback)
+    global_counts = jnp.asarray(
+        np.bincount(y_np, minlength=c).astype(np.float32))
     counts = counts + 1e-6 * global_counts[None, None, :]
     leaf_class = np.asarray(jnp.argmax(counts, axis=-1), np.int32)
 

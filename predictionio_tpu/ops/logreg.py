@@ -30,7 +30,7 @@ class LogRegModel:
 
 
 @partial(jax.jit, static_argnames=("n_classes", "steps"))
-def _fit(features, class_ix, *, n_classes: int, steps: int,
+def _fit(features, class_ix, mask, *, n_classes: int, steps: int,
          lr: float, reg: float):
     import optax
 
@@ -43,7 +43,9 @@ def _fit(features, class_ix, *, n_classes: int, steps: int,
     def loss_fn(params):
         w, b = params
         logits = features @ w + b
-        ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1))
+        per_ex = jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1)
+        # masked mean: sharding-padding rows (mask 0) don't bias the loss
+        ce = -jnp.sum(mask * per_ex) / jnp.maximum(mask.sum(), 1.0)
         return ce + reg * jnp.sum(w * w)
 
     def step(carry, _):
@@ -60,7 +62,10 @@ def _fit(features, class_ix, *, n_classes: int, steps: int,
 
 def logreg_train(features: np.ndarray, labels: np.ndarray, *,
                  steps: int = 200, lr: float = 0.1,
-                 reg: float = 1e-4) -> LogRegModel:
+                 reg: float = 1e-4, mesh=None) -> LogRegModel:
+    """`mesh` shards the batch dimension over "data": full-batch
+    gradients become per-device partials + GSPMD all-reduce; parameters
+    stay replicated."""
     if features.shape[0] == 0:
         raise ValueError("no training points")
     uniq = np.unique(labels)
@@ -69,7 +74,16 @@ def logreg_train(features: np.ndarray, labels: np.ndarray, *,
     mu = features.mean(axis=0)
     sd = features.std(axis=0) + 1e-8
     fs = ((features - mu) / sd).astype(np.float32)
-    w, b, _ = _fit(jnp.asarray(fs), jnp.asarray(class_ix),
+    mask = np.ones(len(labels), np.float32)
+    if mesh is not None:
+        from predictionio_tpu.parallel import shard_put
+        fs_d, _ = shard_put(fs, mesh)
+        cix_d, _ = shard_put(class_ix, mesh)
+        mask_d, _ = shard_put(mask, mesh)
+    else:
+        fs_d, cix_d, mask_d = (jnp.asarray(fs), jnp.asarray(class_ix),
+                               jnp.asarray(mask))
+    w, b, _ = _fit(fs_d, cix_d, mask_d,
                    n_classes=len(uniq), steps=steps, lr=lr, reg=reg)
     w = np.asarray(w) / sd[:, None]
     b = np.asarray(b) - mu @ w

@@ -50,8 +50,13 @@ def _scores(pi, theta, features):
 
 
 def nb_train(features: np.ndarray, labels: np.ndarray,
-             lam: float = 1.0) -> NaiveBayesModel:
-    """features [n, d] nonnegative; labels [n] arbitrary floats/ints."""
+             lam: float = 1.0, *, mesh=None) -> NaiveBayesModel:
+    """features [n, d] nonnegative; labels [n] arbitrary floats/ints.
+
+    `mesh` shards the sample dimension over the "data" axis: the fit is
+    two segment-sums of sufficient statistics, so GSPMD turns the
+    sharded inputs into per-device partial sums + an all-reduce (padding
+    rows carry valid=0 and vanish from every statistic)."""
     if (features < 0).any():
         raise ValueError("multinomial NB requires nonnegative features")
     if features.shape[0] == 0:
@@ -59,8 +64,16 @@ def nb_train(features: np.ndarray, labels: np.ndarray,
     uniq = np.unique(labels)
     class_ix = np.searchsorted(uniq, labels).astype(np.int32)
     valid = np.ones(len(labels), np.float32)
-    pi, theta = _fit(jnp.asarray(features, jnp.float32),
-                     jnp.asarray(class_ix), jnp.asarray(valid),
+    if mesh is not None:
+        from predictionio_tpu.parallel import shard_put
+        feats_d, _ = shard_put(np.asarray(features, np.float32), mesh)
+        cix_d, _ = shard_put(class_ix, mesh)
+        valid_d, _ = shard_put(valid, mesh)
+    else:
+        feats_d = jnp.asarray(features, jnp.float32)
+        cix_d = jnp.asarray(class_ix)
+        valid_d = jnp.asarray(valid)
+    pi, theta = _fit(feats_d, cix_d, valid_d,
                      jnp.float32(lam), n_classes=len(uniq))
     return NaiveBayesModel(np.asarray(pi), np.asarray(theta), uniq)
 

@@ -110,17 +110,41 @@ def shard_put(a: np.ndarray, mesh, axis: str = "data", fill=0):
     return jax.device_put(a, batch_sharding(mesh, axis, a.ndim)), n
 
 
-def initialize_distributed() -> bool:
-    """Initialize `jax.distributed` on multi-host pods when coordinator env
-    vars are present; no-op (False) on a single host. The analog of the
-    reference forwarding PIO_* env through spark-submit to driver/executors
+_distributed_initialized = False
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Initialize `jax.distributed` for multi-host training; no-op
+    (False) when no coordinator is configured. Explicit arguments
+    override the PIO_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID env
+    vars. Idempotent: a second call in the same process returns True
+    without re-initializing. The analog of the reference forwarding
+    PIO_* env through spark-submit to driver/executors
     (`Runner.scala:213-215,298-305`)."""
-    addr = os.environ.get("PIO_TPU_COORDINATOR")
+    global _distributed_initialized
+    addr = coordinator or os.environ.get("PIO_TPU_COORDINATOR")
     if not addr:
         return False
+    if _distributed_initialized:
+        return True
+
+    def setting(explicit, env_key, what):
+        if explicit is not None:
+            return int(explicit)
+        val = os.environ.get(env_key)
+        if val is None:
+            raise ValueError(
+                f"Multi-host init needs {what}: pass it explicitly "
+                f"(--num-processes/--process-id) or set {env_key}")
+        return int(val)
+
+    n_proc = setting(num_processes, "PIO_TPU_NUM_PROCESSES",
+                     "the process count")
+    pid = setting(process_id, "PIO_TPU_PROCESS_ID", "this process's id")
     import jax
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ["PIO_TPU_NUM_PROCESSES"]),
-        process_id=int(os.environ["PIO_TPU_PROCESS_ID"]))
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=n_proc, process_id=pid)
+    _distributed_initialized = True
     return True

@@ -1,0 +1,99 @@
+"""Multi-host distributed training tests.
+
+Two REAL processes, each with 4 virtual CPU devices, joined through
+`jax.distributed` (the coordination service) into one 8-device mesh —
+the analog of the reference forwarding PIO_* env across the spark-submit
+boundary to a multi-executor cluster (`Runner.Scala:213-215,298-305`).
+The sharded ALS factors must agree with single-process training.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os, sys, json
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.parallel import initialize_distributed, make_mesh
+    from predictionio_tpu.ops import als
+
+    assert initialize_distributed(), "distributed init did not trigger"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    rng = np.random.RandomState(0)
+    n = 160
+    u = rng.randint(0, 24, n).astype(np.int32)
+    i = rng.randint(0, 16, n).astype(np.int32)
+    r = rng.uniform(1, 5, n).astype(np.float32)
+    mesh = make_mesh()
+    x, y = als.als_train((u, i, r), 24, 16, rank=4, iterations=3,
+                         reg=0.05, seed=2, mesh=mesh)
+    if jax.process_index() == 0:
+        np.savez(sys.argv[1], x=x, y=y)
+    jax.distributed.shutdown()
+""")
+
+
+@pytest.mark.slow
+class TestTwoProcessTraining:
+    def test_factors_agree_with_single_process(self, tmp_path):
+        port = _free_port()
+        out_file = str(tmp_path / "factors.npz")
+        worker = tmp_path / "worker.py"
+        worker.write_text(_WORKER)
+        procs = []
+        for pid in range(2):
+            env = dict(
+                os.environ,
+                PYTHONPATH=REPO,
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PIO_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                PIO_TPU_NUM_PROCESSES="2",
+                PIO_TPU_PROCESS_ID=str(pid),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker), out_file],
+                env=env, cwd=str(tmp_path),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out
+        got = np.load(out_file)
+
+        # single-process reference on an 8-device virtual mesh, same seed
+        from predictionio_tpu.ops import als
+        from predictionio_tpu.parallel import make_mesh
+
+        rng = np.random.RandomState(0)
+        n = 160
+        u = rng.randint(0, 24, n).astype(np.int32)
+        i = rng.randint(0, 16, n).astype(np.int32)
+        r = rng.uniform(1, 5, n).astype(np.float32)
+        x_ref, y_ref = als.als_train((u, i, r), 24, 16, rank=4,
+                                     iterations=3, reg=0.05, seed=2,
+                                     mesh=make_mesh())
+        np.testing.assert_allclose(got["x"], x_ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got["y"], y_ref, rtol=1e-4, atol=1e-5)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
