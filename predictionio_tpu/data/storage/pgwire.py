@@ -1,0 +1,339 @@
+"""Minimal pure-Python PostgreSQL wire-protocol client.
+
+Plays the role of the JDBC driver + scalikejdbc connection layer under
+the reference's default storage backend
+(`storage/jdbc/src/main/scala/.../JDBC{LEvents,Models,...}.scala`,
+`JDBCUtils.scala`). No psycopg/pg8000 is assumed — this speaks the v3
+frontend/backend protocol directly over a socket:
+
+  - startup + authentication: trust, cleartext password, md5, and
+    SCRAM-SHA-256 (RFC 5802/7677; channel binding not used)
+  - the EXTENDED query protocol (Parse/Bind/Describe/Execute/Sync) with
+    text-format parameters, so values never interpolate into SQL
+  - OID-aware result decoding (ints, bools, bytea hex, text), so DAO
+    code sees Python types
+
+Thread safety follows the sqlite driver's model: one connection guarded
+by an RLock owned by the storage client.
+
+Scope note: this is a storage driver, not a general DBAPI — it
+implements exactly what the DAO layer (`sqldao.py`) needs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import socket
+import struct
+from base64 import b64decode, b64encode
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class PgError(Exception):
+    """Server-reported error; `code` is the SQLSTATE (e.g. 23505 =
+    unique_violation)."""
+
+    def __init__(self, fields: Dict[str, str]):
+        self.fields = fields
+        self.code = fields.get("C", "")
+        super().__init__(fields.get("M", "postgres error"))
+
+
+UNIQUE_VIOLATION = "23505"
+
+
+# -- message encoding (pure functions; unit-tested directly) ---------------
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def encode_startup(user: str, database: str) -> bytes:
+    body = struct.pack("!I", 196608)   # protocol 3.0
+    for k, v in (("user", user), ("database", database)):
+        body += k.encode() + b"\0" + v.encode() + b"\0"
+    body += b"\0"
+    return struct.pack("!I", len(body) + 4) + body
+
+
+def encode_password(password: str) -> bytes:
+    return _msg(b"p", password.encode() + b"\0")
+
+
+def encode_md5_password(user: str, password: str, salt: bytes) -> bytes:
+    inner = hashlib.md5(password.encode() + user.encode()).hexdigest()
+    outer = hashlib.md5(inner.encode() + salt).hexdigest()
+    return encode_password("md5" + outer)
+
+
+def encode_parse(sql: str) -> bytes:
+    return _msg(b"P", b"\0" + sql.encode() + b"\0" + struct.pack("!H", 0))
+
+
+def encode_bind(params: Sequence[Optional[bytes]]) -> bytes:
+    body = b"\0\0"                          # unnamed portal + statement
+    body += struct.pack("!H", 1) + struct.pack("!H", 0)   # all text fmt
+    body += struct.pack("!H", len(params))
+    for p in params:
+        if p is None:
+            body += struct.pack("!i", -1)
+        else:
+            body += struct.pack("!I", len(p)) + p
+    body += struct.pack("!H", 0)            # result formats: default text
+    return _msg(b"B", body)
+
+
+def encode_describe_portal() -> bytes:
+    return _msg(b"D", b"P\0")
+
+
+def encode_execute() -> bytes:
+    return _msg(b"E", b"\0" + struct.pack("!I", 0))
+
+
+def encode_sync() -> bytes:
+    return _msg(b"S", b"")
+
+
+# -- SCRAM-SHA-256 (RFC 5802), client side ----------------------------------
+
+class ScramClient:
+    """SCRAM-SHA-256 without channel binding. Exposed for direct
+    unit-testing against the RFC 7677 example exchange."""
+
+    def __init__(self, user: str, password: str,
+                 nonce: Optional[str] = None):
+        self.user = user
+        self.password = password
+        self.nonce = nonce or b64encode(os.urandom(18)).decode()
+        self.gs2 = "n,,"
+        self.client_first_bare = f"n={user},r={self.nonce}"
+
+    def client_first(self) -> str:
+        return self.gs2 + self.client_first_bare
+
+    def client_final(self, server_first: str) -> str:
+        attrs = dict(kv.split("=", 1) for kv in server_first.split(","))
+        combined_nonce = attrs["r"]
+        if not combined_nonce.startswith(self.nonce):
+            raise PgError({"M": "SCRAM server nonce mismatch", "C": ""})
+        salt = b64decode(attrs["s"])
+        iters = int(attrs["i"])
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        channel = "c=" + b64encode(self.gs2.encode()).decode()
+        final_no_proof = f"{channel},r={combined_nonce}"
+        auth_message = ",".join(
+            (self.client_first_bare, server_first, final_no_proof)).encode()
+        signature = hmac.new(stored_key, auth_message,
+                             hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        self._server_key = hmac.new(salted, b"Server Key",
+                                    hashlib.sha256).digest()
+        self._auth_message = auth_message
+        return final_no_proof + ",p=" + b64encode(proof).decode()
+
+    def verify_server_final(self, server_final: str) -> bool:
+        attrs = dict(kv.split("=", 1) for kv in server_final.split(","))
+        expect = hmac.new(self._server_key, self._auth_message,
+                          hashlib.sha256).digest()
+        return hmac.compare_digest(b64decode(attrs["v"]), expect)
+
+
+# -- result decoding --------------------------------------------------------
+
+_INT_OIDS = {20, 21, 23, 26, 28}
+_BOOL_OID = 16
+_BYTEA_OID = 17
+_FLOAT_OIDS = {700, 701, 1700}
+
+
+def decode_value(raw: Optional[bytes], oid: int):
+    if raw is None:
+        return None
+    if oid in _INT_OIDS:
+        return int(raw)
+    if oid == _BOOL_OID:
+        return raw == b"t"
+    if oid == _BYTEA_OID:
+        text = raw.decode()
+        if text.startswith("\\x"):
+            return bytes.fromhex(text[2:])
+        return raw   # legacy escape format not expected from PG >= 9
+    if oid in _FLOAT_OIDS:
+        return float(raw)
+    return raw.decode("utf-8")
+
+
+def encode_param(v) -> Optional[bytes]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"true" if v else b"false"
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    return str(v).encode("utf-8")
+
+
+@dataclass
+class QueryResult:
+    rows: List[tuple]
+    rowcount: int
+
+
+class PgConnection:
+    """One socket speaking the extended query protocol, autocommit."""
+
+    def __init__(self, host: str = "localhost", port: int = 5432, *,
+                 user: str = "postgres", password: str = "",
+                 database: str = "postgres", timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        self.user = user
+        self.sock.sendall(encode_startup(user, database))
+        self._authenticate(password)
+        # drain until ReadyForQuery
+        self._wait_ready()
+
+    # -- low-level framing --------------------------------------------------
+    def _recv_message(self) -> Tuple[bytes, bytes]:
+        while len(self._buf) < 5:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise PgError({"M": "connection closed by server", "C": ""})
+            self._buf += chunk
+        tag = self._buf[:1]
+        (length,) = struct.unpack("!I", self._buf[1:5])
+        while len(self._buf) < 1 + length:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise PgError({"M": "connection closed by server", "C": ""})
+            self._buf += chunk
+        payload = self._buf[5:1 + length]
+        self._buf = self._buf[1 + length:]
+        return tag, payload
+
+    @staticmethod
+    def _error_fields(payload: bytes) -> Dict[str, str]:
+        fields = {}
+        for part in payload.split(b"\0"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+        return fields
+
+    # -- auth ----------------------------------------------------------------
+    def _authenticate(self, password: str) -> None:
+        scram: Optional[ScramClient] = None
+        while True:
+            tag, payload = self._recv_message()
+            if tag == b"E":
+                raise PgError(self._error_fields(payload))
+            if tag != b"R":
+                continue   # parameter status / backend key before auth done
+            (code,) = struct.unpack("!I", payload[:4])
+            if code == 0:
+                return
+            if code == 3:
+                self.sock.sendall(encode_password(password))
+            elif code == 5:
+                self.sock.sendall(encode_md5_password(
+                    self.user, password, payload[4:8]))
+            elif code == 10:
+                mechs = payload[4:].split(b"\0")
+                if b"SCRAM-SHA-256" not in mechs:
+                    raise PgError({"M": f"unsupported SASL mechanisms "
+                                        f"{mechs}", "C": ""})
+                scram = ScramClient(self.user, password)
+                first = scram.client_first().encode()
+                body = (b"SCRAM-SHA-256\0"
+                        + struct.pack("!I", len(first)) + first)
+                self.sock.sendall(_msg(b"p", body))
+            elif code == 11:
+                assert scram is not None
+                final = scram.client_final(payload[4:].decode())
+                self.sock.sendall(_msg(b"p", final.encode()))
+            elif code == 12:
+                assert scram is not None
+                if not scram.verify_server_final(payload[4:].decode()):
+                    raise PgError({"M": "SCRAM server signature invalid",
+                                   "C": ""})
+            else:
+                raise PgError({"M": f"unsupported auth method {code}",
+                               "C": ""})
+
+    def _wait_ready(self) -> None:
+        err = None
+        while True:
+            tag, payload = self._recv_message()
+            if tag == b"E":
+                err = PgError(self._error_fields(payload))
+            elif tag == b"Z":
+                if err:
+                    raise err
+                return
+
+    # -- queries -------------------------------------------------------------
+    def execute(self, sql: str, params: Sequence = ()) -> QueryResult:
+        """Run one statement via the extended protocol; `$1..$n`
+        placeholders; returns typed rows + affected rowcount."""
+        self.sock.sendall(
+            encode_parse(sql)
+            + encode_bind([encode_param(p) for p in params])
+            + encode_describe_portal()
+            + encode_execute()
+            + encode_sync())
+        oids: List[int] = []
+        rows: List[tuple] = []
+        rowcount = 0
+        err: Optional[PgError] = None
+        while True:
+            tag, payload = self._recv_message()
+            if tag == b"T":                       # RowDescription
+                (nf,) = struct.unpack("!H", payload[:2])
+                off = 2
+                oids = []
+                for _ in range(nf):
+                    end = payload.index(b"\0", off)
+                    off = end + 1
+                    _table, _attr, oid = struct.unpack(
+                        "!IhI", payload[off:off + 10])
+                    off += 18
+                    oids.append(oid)
+            elif tag == b"D":                     # DataRow
+                (nf,) = struct.unpack("!H", payload[:2])
+                off = 2
+                vals = []
+                for i in range(nf):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        vals.append(None)
+                    else:
+                        vals.append(decode_value(payload[off:off + ln],
+                                                 oids[i]))
+                        off += ln
+                rows.append(tuple(vals))
+            elif tag == b"C":                     # CommandComplete
+                words = payload.rstrip(b"\0").split()
+                if words and words[-1].isdigit():
+                    rowcount = int(words[-1])
+            elif tag == b"E":
+                err = PgError(self._error_fields(payload))
+            elif tag == b"Z":                     # ReadyForQuery
+                if err:
+                    raise err
+                return QueryResult(rows, rowcount)
+            # ignore: ParseComplete(1) BindComplete(2) NoData(n)
+            # ParameterStatus(S) NoticeResponse(N) EmptyQueryResponse(I)
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(_msg(b"X", b""))
+        except OSError:
+            pass
+        self.sock.close()

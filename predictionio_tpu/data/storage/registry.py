@@ -59,7 +59,26 @@ def _register_builtin_drivers() -> None:
     register_driver("LOCALFS", localfs.LocalFSStorageClient, {
         "Models": localfs.LocalFSModels,
     })
-    from predictionio_tpu.data.storage import objectstore
+    from predictionio_tpu.data.storage import evlog, objectstore, postgres
+
+    # event data on the native C++ append-only journal (the hbase-role
+    # durable event store)
+    register_driver("EVLOG", evlog.EvlogStorageClient, {
+        "Events": evlog.EvlogEvents,
+    })
+
+    # networked SQL backend (the reference's jdbc/PGSQL driver set);
+    # the wire connection is only opened when the source is used
+    for type_name in ("POSTGRES", "PGSQL"):
+        register_driver(type_name, postgres.PostgresStorageClient, {
+            "Apps": postgres.PostgresApps,
+            "AccessKeys": postgres.PostgresAccessKeys,
+            "Channels": postgres.PostgresChannels,
+            "EngineInstances": postgres.PostgresEngineInstances,
+            "EvaluationInstances": postgres.PostgresEvaluationInstances,
+            "Models": postgres.PostgresModels,
+            "Events": postgres.PostgresEvents,
+        })
 
     # S3/HDFS are the reference's driver names (S3Models.scala,
     # HDFSModels.scala); OBJECTSTORE is the generic fsspec-URL form.

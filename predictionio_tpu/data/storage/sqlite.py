@@ -28,6 +28,40 @@ from predictionio_tpu.data.storage.base import (
 )
 
 
+# Meta-table DDL in SQLite dialect; the Postgres driver reuses this list
+# through its dialect translation (`postgres._translate`), so the two SQL
+# backends can never drift apart structurally.
+META_DDL = (
+    """CREATE TABLE IF NOT EXISTS apps (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT NOT NULL UNIQUE,
+        description TEXT)""",
+    """CREATE TABLE IF NOT EXISTS access_keys (
+        accesskey TEXT PRIMARY KEY,
+        appid INTEGER NOT NULL,
+        events TEXT NOT NULL)""",
+    """CREATE TABLE IF NOT EXISTS channels (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT NOT NULL,
+        appid INTEGER NOT NULL)""",
+    """CREATE TABLE IF NOT EXISTS engine_instances (
+        id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
+        endtime INTEGER, engineid TEXT, engineversion TEXT,
+        enginevariant TEXT, enginefactory TEXT, batch TEXT,
+        env TEXT, runtimeconf TEXT, datasourceparams TEXT,
+        preparatorparams TEXT, algorithmsparams TEXT,
+        servingparams TEXT)""",
+    """CREATE TABLE IF NOT EXISTS evaluation_instances (
+        id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
+        endtime INTEGER, evaluationclass TEXT,
+        engineparamsgeneratorclass TEXT, batch TEXT, env TEXT,
+        runtimeconf TEXT, evaluatorresults TEXT,
+        evaluatorresultshtml TEXT, evaluatorresultsjson TEXT)""",
+    """CREATE TABLE IF NOT EXISTS models (
+        id TEXT PRIMARY KEY, models BLOB)""",
+)
+
+
 class SQLiteStorageClient:
     """Owns the sqlite connection; all DAOs of a source share one client."""
 
@@ -45,34 +79,8 @@ class SQLiteStorageClient:
 
     def _init_meta_tables(self) -> None:
         with self.lock, self.conn:
-            c = self.conn
-            c.execute("""CREATE TABLE IF NOT EXISTS apps (
-                id INTEGER PRIMARY KEY AUTOINCREMENT,
-                name TEXT NOT NULL UNIQUE,
-                description TEXT)""")
-            c.execute("""CREATE TABLE IF NOT EXISTS access_keys (
-                accesskey TEXT PRIMARY KEY,
-                appid INTEGER NOT NULL,
-                events TEXT NOT NULL)""")
-            c.execute("""CREATE TABLE IF NOT EXISTS channels (
-                id INTEGER PRIMARY KEY AUTOINCREMENT,
-                name TEXT NOT NULL,
-                appid INTEGER NOT NULL)""")
-            c.execute("""CREATE TABLE IF NOT EXISTS engine_instances (
-                id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
-                endtime INTEGER, engineid TEXT, engineversion TEXT,
-                enginevariant TEXT, enginefactory TEXT, batch TEXT,
-                env TEXT, runtimeconf TEXT, datasourceparams TEXT,
-                preparatorparams TEXT, algorithmsparams TEXT,
-                servingparams TEXT)""")
-            c.execute("""CREATE TABLE IF NOT EXISTS evaluation_instances (
-                id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
-                endtime INTEGER, evaluationclass TEXT,
-                engineparamsgeneratorclass TEXT, batch TEXT, env TEXT,
-                runtimeconf TEXT, evaluatorresults TEXT,
-                evaluatorresultshtml TEXT, evaluatorresultsjson TEXT)""")
-            c.execute("""CREATE TABLE IF NOT EXISTS models (
-                id TEXT PRIMARY KEY, models BLOB)""")
+            for ddl in META_DDL:
+                self.conn.execute(ddl)
 
     def close(self) -> None:
         with self.lock:
