@@ -15,11 +15,21 @@ XLA program:
      to the bucket cap. Buckets mean the heavy tail of prolific users costs
      one big slab instead of padding every user to the global max degree.
   2. One half-iteration gathers the opposite side's factors `Y[idx]`
-     (`[rows_b, cap_b, rank]`), forms per-row normal equations with one
-     einsum (MXU-batched), adds ALS-WR regularization `lambda * n_row * I`
-     (MLlib's default scaling), and solves all rows with batched
-     Jacobi-preconditioned CG (`ops.linalg.pcg_solve` — XLA's batched
-     Cholesky runs at ~0.02 TFLOP/s on TPU and dominated the step).
+     (`[rows_b, cap_b, rank]`), forms per-row normal equations, adds
+     ALS-WR regularization `lambda * n_row * I` (MLlib's default
+     scaling), and solves all rows. The hot path (rank > 16) is
+     `_solve_slab_paired`: bf16 gathered operands, consecutive-row
+     PAIRING so the Gram einsum produces full 128x128 MXU tiles, f32
+     accumulation, and warm-started Jacobi-CG with residual tracking.
+     Rank <= 16 uses the exact blocked Cholesky (`ops.linalg.spd_solve`).
+     Why, from the v5e roofline (all measured, r4): the factor gather is
+     ROW-RATE-bound (~390M rows/s f32 / ~450M bf16, independent of row
+     width <= 128 lanes) and is the hard floor of the whole step;
+     RxR-batched einsums reach <2 TFLOP/s (each batch element fills only
+     a 64x64 corner of the MXU) while the paired form is ~3x faster;
+     XLA's batched Cholesky runs at ~0.02 TFLOP/s; and a fixed-32-iter
+     CG re-reads every normal matrix from HBM per iteration, while warm
+     starting cuts the iterations ~4x at equal final RMSE.
   3. Implicit feedback uses the Hu-Koren-Volinsky trick: A_row =
      Y^T Y + sum_k alpha*r_k * y_k y_k^T (+ reg), b_row = sum_k
      (1 + alpha*r_k) y_k, so cost scales with observed entries only.
@@ -63,9 +73,40 @@ import numpy as np
 from predictionio_tpu.ingest import BiMap, RatingColumns
 
 # degree-bucket caps grow geometrically; a row of degree d lands in the
-# smallest bucket with cap >= d
+# smallest bucket with cap >= d. The x1.5 ladder (rounded up to a
+# multiple of 8 for TPU sublane alignment) bounds padding at 1.5x the
+# real entry count — the r3 x4 ladder padded ML-25M to ~2x, and the
+# gather that reads every padded slot is the measured bottleneck of the
+# whole training step (row-rate-bound at ~390-450M rows/s on a v5e; see
+# module docstring), so padding is gather wall-clock 1:1.
 _BUCKET_BASE = 16
-_BUCKET_GROWTH = 4
+_BUCKET_GROWTH = 1.5
+
+# sentinel row index for slab padding rows (scatter mode="drop" discards
+# them; _pack_by_owner maps them to an in-range dropped local slot)
+_FILL_ROW = np.int32(2**31 - 1)
+
+# ranks <= this solve via the exact blocked Cholesky (ops.linalg.
+# spd_solve): at one 16-wide block it is a short, fully batched program
+# and beats CG (this is also what keeps the ML-100k rank-10 path on the
+# exact solver — the r3 regression was CG burning 4x the FLOPs there).
+_SMALL_RANK = 16
+
+# warm-started CG iteration cap for the rank > _SMALL_RANK path. With
+# the previous sweep's factors as x0, 8 iterations reach ~2e-4 max
+# relative residual on the ML-25M workload (measured); the residual is
+# tracked and surfaced so a badly conditioned problem (tiny reg) is
+# flagged instead of silently wrong.
+_CG_ITERS = 8
+
+
+def _cap_ladder(max_count: int) -> np.ndarray:
+    """Bucket caps: BASE, then x_BUCKET_GROWTH steps rounded up to a
+    multiple of 8, up to max_count."""
+    caps = [_BUCKET_BASE]
+    while caps[-1] < max_count:
+        caps.append(int(math.ceil(caps[-1] * _BUCKET_GROWTH / 8) * 8))
+    return np.asarray(caps, np.int64)
 
 # Per-slab transient memory budgets (bytes, f32). A bucket slab of B rows
 # x cap K at rank R materializes a [B, K, R] factor gather and [B, R, R]
@@ -111,12 +152,9 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
     order = np.argsort(row_ix, kind="stable")
     r, c, v = row_ix[order], col_ix[order], val[order]
     uniq, starts, counts = np.unique(r, return_index=True, return_counts=True)
-    # bucket cap per unique row: smallest BASE * GROWTH^k >= count
-    caps_per_row = np.full(len(uniq), _BUCKET_BASE, np.int64)
-    grow = counts > caps_per_row
-    while grow.any():
-        caps_per_row[grow] *= _BUCKET_GROWTH
-        grow = counts > caps_per_row
+    # bucket cap per unique row: smallest ladder cap >= count
+    ladder = _cap_ladder(int(counts.max()) if len(counts) else _BUCKET_BASE)
+    caps_per_row = ladder[np.searchsorted(ladder, counts)]
     out = _SideBuckets([], [], [], [], n_rows)
     for cap in np.unique(caps_per_row):
         sel = caps_per_row == cap
@@ -136,14 +174,23 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
         if rank is None:
             chunk = nb
         else:
-            chunk = max(1, min(_SLAB_NORMAL_BUDGET // (rank * rank * 4),
+            chunk = max(2, min(_SLAB_NORMAL_BUDGET // (rank * rank * 4),
                                _SLAB_GATHER_BUDGET // (int(cap) * rank * 4)))
+            chunk -= chunk % 2   # paired solver consumes rows two at a time
         for s in range(0, nb, max(chunk, 1)):
             e = min(s + chunk, nb)
-            out.rows.append(rows[s:e])
-            out.idx.append(idx[s:e])
-            out.val.append(vals[s:e])
-            out.msk.append(msk[s:e])
+            rws, ix, vl, mk = rows[s:e], idx[s:e], vals[s:e], msk[s:e]
+            if len(rws) % 2:
+                # pad to even rows for the paired solver; the fill row is
+                # dropped at scatter time (see _FILL_ROW)
+                rws = np.concatenate([rws, np.asarray([_FILL_ROW], np.int32)])
+                ix = np.concatenate([ix, np.zeros((1, cap), np.int32)])
+                vl = np.concatenate([vl, np.zeros((1, cap), np.float32)])
+                mk = np.concatenate([mk, np.zeros((1, cap), np.float32)])
+            out.rows.append(rws)
+            out.idx.append(ix)
+            out.val.append(vl)
+            out.msk.append(mk)
     return out
 
 
@@ -169,48 +216,58 @@ def pack_ratings(u_ix: np.ndarray, i_ix: np.ndarray, val: np.ndarray,
         n_users=n_users, n_items=n_items, rank=rank)
 
 
-def iteration_flops(packed: PackedRatings) -> int:
+def iteration_flops(packed: PackedRatings,
+                    cg_iters: int = _CG_ITERS) -> int:
     """Closed-form FLOPs of ONE full ALS iteration (both half-steps) over
     the PADDED slab shapes — the denominator work for achieved-FLOP/s /
     MFU accounting, counting the work that actually EXECUTES. Convention:
     multiply-add = 2 FLOPs. Per slab of B rows x cap K at rank R:
-      Gram einsum  bkr,bks,bk->brs : 2*B*K*R^2
-      rhs einsum   bkr,bk->br      : 2*B*K*R
-      PCG solve (`_solve_bucket` runs min(32, R+8) iterations, each one
-      [R,R] matvec + ~4 R-vector ops): B*iters*(2*R^2 + 8*R)
-    (CG executes ~4x the FLOPs of the direct Cholesky it replaced —
-    2*(R^3/3 + 2R^2) per row — but in batched-matmul form; masking
-    elementwise multiplies counted as free.)"""
+
+    rank > _SMALL_RANK (the paired-MXU path, see _solve_slab_paired):
+      paired Gram  gkp,gkq->gpq : 2*(B/2)*K*(2R)^2 = 4*B*K*R^2
+        (2x the useful 2*B*K*R^2 — the off-diagonal blocks of each
+        128-wide pair are junk, the price of full 128x128 MXU tiles)
+      rhs einsums               : 2*B*K*R
+      warm CG, <= cg_iters per sweep (early exit may do fewer; this is
+      the cap actually compiled): B*cg_iters*(2*R^2 + 8*R) + one
+      warm-start matvec B*2*R^2
+
+    rank <= _SMALL_RANK (exact spd_solve path): Gram 2*B*K*R^2 + rhs +
+      Cholesky ~2*(R^3/3 + 2R^2) per row."""
     r = packed.rank
-    solve_iters = min(32, r + 8)
     total = 0
+    paired = r > _SMALL_RANK
     for side in (packed.user_side, packed.item_side):
         for idx in side.idx:
             b, k = idx.shape
-            total += 2 * b * k * r * r + 2 * b * k * r
-            total += b * solve_iters * (2 * r * r + 8 * r)
+            if paired:
+                total += 4 * b * k * r * r + 2 * b * k * r
+                total += b * (cg_iters + 1) * (2 * r * r + 8 * r)
+            else:
+                total += 2 * b * k * r * r + 2 * b * k * r
+                total += b * 2 * (r ** 3 // 3 + 2 * r * r)
     return total
 
 
 @partial(jax.jit, static_argnames=("implicit",))
 def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
-    """Solve normal equations for one bucket slab.
+    """Solve normal equations for one bucket slab — the exact f32 path.
 
     factors: [n_opposite, rank] opposite-side factors (replicated)
     idx/val/msk: [rows_b, cap_b]
     yty: [rank, rank] Gram matrix of opposite factors (implicit only)
     Returns [rows_b, rank] solutions.
 
-    The per-row SPD systems are solved with Jacobi-preconditioned CG
-    (`ops.linalg.pcg_solve`): on TPU, XLA's batched Cholesky runs at
-    ~0.02 TFLOP/s and was the single largest cost of the ML-25M training
-    step, while CG is a handful of batched einsums. ALS-WR
-    regularization keeps the systems well-conditioned; oracle-parity
-    tests gate the accuracy.
+    Solver choice: rank <= _SMALL_RANK uses the exact blocked Cholesky
+    (`spd_solve` — one 16-wide block, short batched program, exact
+    regardless of conditioning); larger ranks use Jacobi-preconditioned
+    CG at a conservative min(32, rank+8) cap. The TPU training hot loop
+    uses `_solve_slab_paired` instead; this function is the reference /
+    small-rank / CPU path, and the direct API the unit tests drive.
     """
     import jax.numpy as jnp
 
-    from predictionio_tpu.ops.linalg import pcg_solve
+    from predictionio_tpu.ops.linalg import pcg_solve, spd_solve
 
     rank = factors.shape[1]
     yg = factors[idx]                                   # [B, K, R] gather
@@ -229,8 +286,96 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     a = a + (reg * n_row)[:, None, None] * eye
     # pad rows (n_row == 0) get an identity system -> solution 0
     a = jnp.where((n_row > 0)[:, None, None], a, eye)
-    x = pcg_solve(a, b, iters=min(32, rank + 8))
+    if rank <= _SMALL_RANK:
+        x = spd_solve(a, b)
+    else:
+        x = pcg_solve(a, b, iters=min(32, rank + 8))
     return jnp.where((n_row > 0)[:, None], x, 0.0)
+
+
+@partial(jax.jit, static_argnames=("implicit", "cg_iters", "cast"))
+def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
+                       *, implicit: bool, cg_iters: int, cast):
+    """The TPU hot-loop slab solver: paired-rows Gram on full MXU tiles +
+    warm-started CG. Returns ([rows_b, R] solutions, [rows_b] relative
+    residuals).
+
+    Why this shape (each choice measured on a v5e against the ML-25M
+    workload, see r4 bench roofline):
+      * The factor gather is row-rate-bound (~390M rows/s f32, ~450M
+        bf16, independent of row WIDTH up to 128 lanes) — it is the
+        step's hard floor, so the gathered operand is cast (`cast`,
+        normally bfloat16) and every padded slot counts.
+      * A batched [K,R]x[K,R] Gram per row runs the MXU at <2 TFLOP/s
+        because each batch element only fills a RxR corner of the
+        128x128 systolic array. Pairing consecutive rows (lane-concat of
+        their gathered factors -> [B/2, K, 2R]) makes the einsum produce
+        [2R, 2R] tiles: 2x redundant FLOPs (the cross blocks are junk)
+        for ~3x wall-clock at R=64.
+      * Masks are {0,1} so m^2 = m: ONE masked gathered copy serves both
+        Gram operands (for implicit, sqrt-confidence weights do the same
+        trick), with f32 accumulation via preferred_element_type.
+      * The pair is split back to [B, R, R] before CG so the junk blocks
+        are neither read per CG iteration nor coupled into the solve.
+      * CG warm-starts from the CURRENT factor rows (inexact ALS:
+        block-coordinate descent tolerates approximate solves; measured
+        RMSE matches the exact solve at cg_iters=8 with max residual
+        ~2e-4 on ML-25M). The returned residuals let `als_train` flag
+        non-convergence (low-reg / ill-conditioned systems) instead of
+        going silently wrong.
+    """
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.linalg import pcg_solve
+
+    R = own.shape[1]
+    B, K = idx.shape
+    G = B // 2
+    i2 = idx.reshape(G, 2, K)
+    v2 = val.reshape(G, 2, K)
+    m2 = msk.reshape(G, 2, K)
+    if implicit:
+        conf_e = alpha * jnp.abs(v2[:, 0]) * m2[:, 0]
+        conf_o = alpha * jnp.abs(v2[:, 1]) * m2[:, 1]
+        w_e = jnp.sqrt(conf_e).astype(cast)[..., None]
+        w_o = jnp.sqrt(conf_o).astype(cast)[..., None]
+    else:
+        w_e = m2[:, 0].astype(cast)[..., None]
+        w_o = m2[:, 1].astype(cast)[..., None]
+    ygm = jnp.concatenate([opp_cast[i2[:, 0]] * w_e,
+                           opp_cast[i2[:, 1]] * w_o], axis=-1)  # [G,K,2R]
+    a2 = jnp.einsum("gkp,gkq->gpq", ygm, ygm,
+                    preferred_element_type=jnp.float32)        # [G,2R,2R]
+    if implicit:
+        # b weights against the sqrt-conf-weighted copy: pref*(1+c) =
+        # (sqrt(c)) * pref*(1+c)/sqrt(c); c==0 entries contribute 0 to b
+        # in HKV form (pref counts only r > 0, and r > 0 => c > 0)
+        def bw(v, c):   # c = alpha*|v|*m already encodes the mask
+            return jnp.where(c > 0, (v > 0) * (1.0 + c) *
+                             jax.lax.rsqrt(jnp.maximum(c, 1e-30)), 0.0)
+        wb_e = bw(v2[:, 0], conf_e)
+        wb_o = bw(v2[:, 1], conf_o)
+    else:
+        wb_e = v2[:, 0] * m2[:, 0]
+        wb_o = v2[:, 1] * m2[:, 1]
+    be = jnp.einsum("gkr,gk->gr", ygm[..., :R], wb_e.astype(cast),
+                    preferred_element_type=jnp.float32)
+    bo = jnp.einsum("gkr,gk->gr", ygm[..., R:], wb_o.astype(cast),
+                    preferred_element_type=jnp.float32)
+    # un-pair: [G,2R,2R] diag blocks -> [B,R,R]; [G,2R] -> [B,R]
+    a = jnp.stack([a2[:, :R, :R], a2[:, R:, R:]], axis=1).reshape(B, R, R)
+    b = jnp.stack([be, bo], axis=1).reshape(B, R)
+    if implicit:
+        a = a + yty
+    n_row = msk.sum(axis=1)
+    d = reg * n_row + (n_row == 0).astype(jnp.float32)  # pad rows -> I
+    a = a + d[:, None, None] * jnp.eye(R, dtype=jnp.float32)
+    live = (n_row > 0)[:, None]
+    safe = jnp.minimum(rows, own.shape[0] - 1)          # _FILL_ROW-safe
+    x0 = jnp.where(live, own[safe], 0.0)
+    x, rel, _ = pcg_solve(a, b, iters=cg_iters, x0=x0, rtol=1e-5,
+                          return_info=True)
+    return jnp.where(live, x, 0.0), jnp.where(live[:, 0], rel, 0.0)
 
 
 def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
@@ -241,9 +386,13 @@ def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
     Host-side, vectorized."""
     packed = []
     for rows, idx, vals, msk in zip(side.rows, side.idx, side.val, side.msk):
+        real = rows != _FILL_ROW           # _pack_side even-padding rows
+        rows, idx = rows[real], idx[real]
+        vals, msk = vals[real], msk[real]
         owner = rows // block
         counts = np.bincount(owner, minlength=n_dev)
         rb = max(int(counts.max()), 1)
+        rb += rb % 2                       # even rows per device (pairing)
         order = np.argsort(owner, kind="stable")
         member, intra = _group_offsets(counts)
         local_rows = np.full((n_dev, rb), block, np.int32)
@@ -261,37 +410,67 @@ def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
     return packed
 
 
-@partial(jax.jit, static_argnames=("implicit", "rank", "mesh"))
+@partial(jax.jit,
+         static_argnames=("implicit", "rank", "mesh", "cg_iters", "cast"))
 def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
-                     n_iter, *, implicit: bool, rank: int, mesh):
+                     n_iter, *, implicit: bool, rank: int, mesh,
+                     cg_iters: int = _CG_ITERS, cast=None):
     """Sharded ALS loop: factor shards stay put; each half-step
-    all-gathers the opposite shard (transient), psums the [rank, rank]
-    Gram for implicit mode, and writes solved rows locally."""
+    all-gathers the opposite shard (transient, cast to `cast` BEFORE the
+    all-gather so the ICI bytes are halved in bf16 mode), psums the
+    [rank, rank] Gram for implicit mode, and writes solved rows locally.
+    Returns (x, y, max relative solver residual)."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    paired = rank > _SMALL_RANK
+
     def body(x_local, y_local, user_slabs, item_slabs):
-        def half_step(own_local, opp_local, slabs):
-            opp_full = jax.lax.all_gather(opp_local, "data", axis=0,
-                                          tiled=True)
+        def half_step(own_local, opp_local, slabs, res):
             if implicit:
                 yty = jax.lax.psum(opp_local.T @ opp_local, "data")
             else:
                 yty = jnp.zeros((rank, rank), jnp.float32)
-            for local_rows, idx, vals, msk in slabs:
-                sol = _solve_bucket(opp_full, idx, vals, msk, reg, alpha,
-                                    yty, implicit=implicit)
-                # fill rows carry local index == block -> dropped
-                own_local = own_local.at[local_rows].set(sol, mode="drop")
-            return own_local
+            if paired:
+                opp_cast = (opp_local.astype(cast) if cast is not None
+                            else opp_local)
+                opp_full = jax.lax.all_gather(opp_cast, "data", axis=0,
+                                              tiled=True)
+                for local_rows, idx, vals, msk in slabs:
+                    sol, rel = _solve_slab_paired(
+                        own_local, opp_full, local_rows, idx, vals, msk,
+                        reg, alpha, yty, implicit=implicit,
+                        cg_iters=cg_iters, cast=cast or jnp.float32)
+                    own_local = own_local.at[local_rows].set(sol,
+                                                             mode="drop")
+                    res = jnp.maximum(res, rel.max())
+            else:
+                opp_full = jax.lax.all_gather(opp_local, "data", axis=0,
+                                              tiled=True)
+                for local_rows, idx, vals, msk in slabs:
+                    sol = _solve_bucket(opp_full, idx, vals, msk, reg,
+                                        alpha, yty, implicit=implicit)
+                    # fill rows carry local index == block -> dropped
+                    own_local = own_local.at[local_rows].set(sol,
+                                                             mode="drop")
+            return own_local, res
 
-        def it(_, xy):
-            x_local, y_local = xy
-            x_local = half_step(x_local, y_local, user_slabs)
-            y_local = half_step(y_local, x_local, item_slabs)
-            return (x_local, y_local)
+        def zero():
+            # per-device residual: mark varying over the mesh axis so the
+            # fori carry type is stable (see shard_map scan-vma docs)
+            return jax.lax.pcast(jnp.float32(0.0), ("data",),
+                                 to="varying")
 
-        return jax.lax.fori_loop(0, n_iter, it, (x_local, y_local))
+        def it(_, state):
+            # final-iteration residual only (see _run_als note)
+            x_local, y_local, _ = state
+            x_local, res = half_step(x_local, y_local, user_slabs, zero())
+            y_local, res = half_step(y_local, x_local, item_slabs, res)
+            return (x_local, y_local, res)
+
+        x_local, y_local, res = jax.lax.fori_loop(
+            0, n_iter, it, (x_local, y_local, zero()))
+        return x_local, y_local, jax.lax.pmax(res, "data")
 
     slab_specs_u = [tuple(P("data", *([None] * (a.ndim - 1)))
                           for a in slab) for slab in user_slabs]
@@ -301,42 +480,61 @@ def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
         body, mesh=mesh,
         in_specs=(P("data", None), P("data", None),
                   slab_specs_u, slab_specs_i),
-        out_specs=(P("data", None), P("data", None)))
+        out_specs=(P("data", None), P("data", None), P()))
     return fsharded(x_sh, y_sh, user_slabs, item_slabs)
 
 
-@partial(jax.jit, static_argnames=("implicit", "rank"))
+@partial(jax.jit, static_argnames=("implicit", "rank", "cg_iters", "cast"))
 def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
-             implicit: bool, rank: int):
+             implicit: bool, rank: int, cg_iters: int = _CG_ITERS,
+             cast=None):
     """The full ALS training loop as one compiled program (module-level
     jit: the cache persists across als_train calls with the same slab
-    shapes). Slabs are pytrees of (rows, idx, val, msk) tuples."""
+    shapes). Slabs are pytrees of (rows, idx, val, msk) tuples. Returns
+    (x, y, max relative solver residual — 0.0 on the exact small-rank
+    path)."""
     import jax.numpy as jnp
 
-    def half_step(own, opposite, slabs):
+    paired = rank > _SMALL_RANK
+
+    def half_step(own, opposite, slabs, res):
         yty = (opposite.T @ opposite if implicit
                else jnp.zeros((rank, rank), jnp.float32))
+        opp_cast = (opposite.astype(cast) if (paired and cast is not None)
+                    else opposite)
         for rows_dev, idx, vals, msk in slabs:
-            sol = _solve_bucket(opposite, idx, vals, msk, reg, alpha,
-                                yty, implicit=implicit)
+            if paired:
+                sol, rel = _solve_slab_paired(
+                    own, opp_cast, rows_dev, idx, vals, msk, reg, alpha,
+                    yty, implicit=implicit, cg_iters=cg_iters,
+                    cast=cast or jnp.float32)
+                res = jnp.maximum(res, rel.max())
+            else:
+                sol = _solve_bucket(opposite, idx, vals, msk, reg, alpha,
+                                    yty, implicit=implicit)
             # slab-padding rows carry an out-of-bounds row index; 'drop'
             # discards their updates instead of clamping onto row n-1
             own = own.at[rows_dev].set(sol, mode="drop")
-        return own
+        return own, res
 
-    def body(_, xy):
-        x, y = xy
-        x = half_step(x, y, user_slabs)
-        y = half_step(y, x, item_slabs)
-        return (x, y)
+    def body(_, state):
+        # residual restarts each iteration: the LAST iteration's solves
+        # are what determine the returned factors' quality (early
+        # iterations legitimately run with cold warm-starts)
+        x, y, _ = state
+        x, res = half_step(x, y, user_slabs, jnp.float32(0.0))
+        y, res = half_step(y, x, item_slabs, res)
+        return (x, y, res)
 
-    return jax.lax.fori_loop(0, n_iter, body, (x, y))
+    return jax.lax.fori_loop(0, n_iter, body, (x, y, jnp.float32(0.0)))
 
 
 def _train_on_mesh(x, y, user_side, item_side, n_users, n_items, mesh, *,
-                   reg, alpha, iterations, implicit, rank):
+                   reg, alpha, iterations, implicit, rank,
+                   cg_iters=_CG_ITERS, cast=None):
     """Shard inputs and run `_run_als_sharded`; returns the still-sharded
-    device factor arrays (padded to a multiple of the mesh size)."""
+    device factor arrays (padded to a multiple of the mesh size) plus
+    the replicated max solver residual."""
     import jax.numpy as jnp
 
     from predictionio_tpu.parallel import batch_sharding, pad_to_multiple
@@ -364,7 +562,8 @@ def _train_on_mesh(x, y, user_side, item_side, n_users, n_items, mesh, *,
     return _run_als_sharded(
         x_sh, y_sh, dev_sides[0], dev_sides[1], jnp.float32(reg),
         jnp.float32(alpha), jnp.int32(iterations),
-        implicit=implicit, rank=rank, mesh=mesh)
+        implicit=implicit, rank=rank, mesh=mesh, cg_iters=cg_iters,
+        cast=cast)
 
 
 @jax.jit
@@ -407,7 +606,9 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
               seed: int = 0,
               mesh=None,
               packed: Optional[PackedRatings] = None,
-              timings: Optional[dict] = None) -> Tuple[np.ndarray, np.ndarray]:
+              timings: Optional[dict] = None,
+              precision: str = "bf16",
+              cg_iters: int = _CG_ITERS) -> Tuple[np.ndarray, np.ndarray]:
     """Train factor matrices (X [n_users, rank], Y [n_items, rank]).
 
     Matches MLlib semantics: ALS-WR regularization (lambda scaled by the
@@ -415,13 +616,28 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     alternations. `mesh` shards each slab's row dimension over the "data"
     axis; None runs single-device. `packed` (from `pack_ratings`) skips
     host-side packing; `timings`, if given, is filled with pack_s /
-    solve_s / fetch_s wall-clock phases (solve_s blocks on the device
-    result, so on a warm compile cache it is pure execution time).
+    solve_s / fetch_s wall-clock phases plus `solver_residual` (the max
+    relative residual of the inexact solves; 0.0 on the exact path).
+
+    `precision` ("bf16" | "f32") sets the dtype of the GATHERED factor
+    operands in the rank > 16 paired path (normal-equation accumulation
+    and the CG solve are always f32) — bf16 is the TPU-first default and
+    is gated by the bench's RMSE-parity check; rank <= 16 and the
+    reference `_solve_bucket` path are exact f32 regardless. `cg_iters`
+    caps the warm-started CG (see _CG_ITERS).
+
+    Conditioning note (MLlib parity): MLlib's CholeskySolver is exact
+    for any regParam; the paired path is iterative, so with reg near 0
+    AND ill-conditioned data the solve may not converge within
+    `cg_iters`. That case is detected (residual > 1e-2) and logged as a
+    warning; raise `cg_iters` or use rank <= 16 / `_solve_bucket` for
+    exact behavior.
     """
     import time as _time
 
     import jax.numpy as jnp
 
+    cast = {"bf16": jnp.bfloat16, "f32": None}[precision]
     t0 = _time.perf_counter()
     if packed is not None:
         user_side, item_side = packed.user_side, packed.item_side
@@ -442,7 +658,7 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     def present_mask(side, n_rows):
         present = np.zeros(max(n_rows, 1), bool)
         for rows in side.rows:
-            present[rows] = True
+            present[rows[rows != _FILL_ROW]] = True
         return present
 
     x, y = init_factors(n_users, n_items, rank, seed,
@@ -451,10 +667,10 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     x, y = jnp.asarray(x), jnp.asarray(y)
 
     if mesh is not None:
-        x_sh, y_sh = _train_on_mesh(
+        x_sh, y_sh, res_sh = _train_on_mesh(
             x, y, user_side, item_side, n_users, n_items, mesh,
             reg=reg, alpha=alpha, iterations=iterations,
-            implicit=implicit, rank=rank)
+            implicit=implicit, rank=rank, cg_iters=cg_iters, cast=cast)
         jax.block_until_ready((x_sh, y_sh))
         t_solve = _time.perf_counter()
 
@@ -470,6 +686,7 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
                 multihost_utils.process_allgather(arr, tiled=True))
 
         out = (fetch(x_sh)[:n_users], fetch(y_sh)[:n_items])
+        _check_residual(float(np.asarray(res_sh)), timings)
         if timings is not None:
             timings.update(pack_s=t_pack - t0, solve_s=t_solve - t_pack,
                            fetch_s=_time.perf_counter() - t_solve)
@@ -486,17 +703,35 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     jax.block_until_ready(dev_sides)
     t_xfer = _time.perf_counter()
 
-    x, y = _run_als(x, y, dev_sides[0], dev_sides[1], jnp.float32(reg),
-                    jnp.float32(alpha), jnp.int32(iterations),
-                    implicit=implicit, rank=rank)
+    x, y, res = _run_als(x, y, dev_sides[0], dev_sides[1], jnp.float32(reg),
+                         jnp.float32(alpha), jnp.int32(iterations),
+                         implicit=implicit, rank=rank, cg_iters=cg_iters,
+                         cast=cast)
     jax.block_until_ready((x, y))
     t_solve = _time.perf_counter()
     out = (np.asarray(x), np.asarray(y))
+    _check_residual(float(np.asarray(res)), timings)
     if timings is not None:
         timings.update(pack_s=t_pack - t0, transfer_s=t_xfer - t_pack,
                        solve_s=t_solve - t_xfer,
                        fetch_s=_time.perf_counter() - t_solve)
     return out
+
+
+def _check_residual(res: float, timings: Optional[dict]) -> None:
+    """Surface the inexact-solver residual (see als_train conditioning
+    note): record it, and warn loudly when the warm-CG solve failed to
+    converge — the exact-Cholesky reference (MLlib CholeskySolver) has
+    no such failure mode, so silence here would be a parity trap."""
+    if timings is not None:
+        timings["solver_residual"] = res
+    if res > 1e-2:
+        import logging
+        logging.getLogger(__name__).warning(
+            "ALS normal-equation solve did not converge (max relative "
+            "residual %.2e > 1e-2): the system is ill-conditioned — "
+            "likely reg is near zero. Raise cg_iters, raise reg, or use "
+            "rank <= %d for the exact solver.", res, _SMALL_RANK)
 
 
 def rmse(x: np.ndarray, y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
@@ -514,22 +749,27 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     """Per-device HBM upper bound (bytes, f32) for the sharded ALS layout
     — the documented memory model (see module docstring).
 
-    Bucket padding is bounded in closed form: a row of degree d lands in a
-    slab of cap(d) <= max(BASE, GROWTH*d), so a side's padded entry count
-    is <= BASE*n_rows + GROWTH*n_ratings. `owner_skew` bounds the extra
-    padding from `_pack_by_owner` equalizing per-device row counts
-    (contiguous id blocks; ~1 for hashed/uniform ids, worst case
+    Bucket padding is bounded in closed form: a row of degree d lands in
+    a slab of cap(d) <= max(BASE, GROWTH*d + 8) (the x1.5 ladder rounds
+    caps up to a multiple of 8), so a side's padded entry count is
+    <= BASE*n_rows + GROWTH*n_ratings + 8*n_rows. `owner_skew` bounds
+    the extra padding from `_pack_by_owner` equalizing per-device row
+    counts (contiguous id blocks; ~1 for hashed/uniform ids, worst case
     n_devices for fully skewed ownership). `peak` is persistent + the
-    worst transient: all-gathered opposite factors, plus the per-slab
-    solve transients — the [B, cap, rank] factor gather and ~3x
-    [B, rank, rank] normal-equation buffers (A, its Cholesky factor, and
-    an intermediate), each capped by the slab-split budgets
+    worst transient: all-gathered opposite factors (bf16 in the default
+    paired path, counted at f32 here as the conservative bound), plus
+    the per-slab solve transients — the [B, cap, rank] gathered+masked
+    factor copy (bf16: cap*rank*2B per row, counted via the gather
+    budget) and ~4x [B, rank, rank] f32 normal-equation buffers (the
+    paired [B/2, 2R, 2R] Gram = 2x a [B, R, R] buffer, its unpaired
+    copy, and CG state), each capped by the slab-split budgets
     (`_SLAB_GATHER_BUDGET` / `_SLAB_NORMAL_BUDGET`), since `_pack_side`
-    splits any bucket whose transients would exceed them and XLA's buffer
-    assignment reuses the previous slab's buffers."""
+    splits any bucket whose transients would exceed them and XLA's
+    buffer assignment reuses the previous slab's buffers."""
     fb = 4  # f32 / int32 bytes
-    padded_user = _BUCKET_BASE * n_users + _BUCKET_GROWTH * n_ratings
-    padded_item = _BUCKET_BASE * n_items + _BUCKET_GROWTH * n_ratings
+    pad_side = _BUCKET_BASE + 8
+    padded_user = pad_side * n_users + _BUCKET_GROWTH * n_ratings
+    padded_item = pad_side * n_items + _BUCKET_GROWTH * n_ratings
     factors_local = (n_users + n_items) * rank * fb / n_devices
     # idx + val + msk per PADDED entry, both sides, sharded with skew
     slabs_local = ((padded_user + padded_item) * 3 * fb / n_devices
@@ -538,7 +778,7 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     slab_gather = min(
         max(padded_user, padded_item) * rank * fb / n_devices * owner_skew,
         _SLAB_GATHER_BUDGET)
-    normal_bufs = 3 * min(
+    normal_bufs = 4 * min(
         max(n_users, n_items) * rank * rank * fb / n_devices * owner_skew,
         _SLAB_NORMAL_BUDGET)
     persistent = factors_local + slabs_local

@@ -63,6 +63,21 @@ def als_train(u_ix: np.ndarray, i_ix: np.ndarray, val: np.ndarray,
     return x, y
 
 
+def als_train_implicit(u_ix: np.ndarray, i_ix: np.ndarray, val: np.ndarray,
+                       n_users: int, n_items: int, *, rank: int,
+                       iterations: int, reg: float, alpha: float,
+                       x0: np.ndarray, y0: np.ndarray):
+    """Full implicit (HKV) alternating loop from the given starting
+    factors — the MLlib `trainImplicit` reference for parity checks
+    (positive-preference data; `user_step_implicit` semantics)."""
+    x = np.asarray(x0, np.float64).copy()
+    y = np.asarray(y0, np.float64).copy()
+    for _ in range(iterations):
+        x = user_step_implicit(y, u_ix, i_ix, val, n_users, reg, alpha)
+        y = user_step_implicit(x, i_ix, u_ix, val, n_items, reg, alpha)
+    return x, y
+
+
 def rmse(x: np.ndarray, y: np.ndarray, u_ix: np.ndarray, i_ix: np.ndarray,
          val: np.ndarray) -> float:
     pred = np.einsum("nr,nr->n", x[u_ix], y[i_ix])

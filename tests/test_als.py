@@ -248,6 +248,96 @@ class TestSlabSplitting:
         assert all(t >= 0 for t in tm.values())
 
 
+class TestPairedSolver:
+    """The rank > 16 TPU hot path (`_solve_slab_paired`: paired-MXU Gram
+    + warm CG) must match the independent numpy oracle, in both f32 and
+    the default bf16 gathered-operand precision."""
+
+    def test_explicit_f32_matches_oracle(self):
+        u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=5)
+        x, y = als.als_train((u_ix, i_ix, val), 60, 40, rank=24,
+                             iterations=6, reg=0.05, seed=2,
+                             precision="f32")
+        x0, y0 = als.init_factors(60, 40, 24, 2)
+        xo, yo = oracle.als_train(u_ix, i_ix, val, 60, 40, rank=24,
+                                  iterations=6, reg=0.05, x0=x0, y0=y0)
+        ours = als.rmse(x, y, u_ix, i_ix, val)
+        ref = oracle.rmse(xo, yo, u_ix, i_ix, val)
+        assert abs(ours - ref) < 5e-3, (ours, ref)
+
+    def test_explicit_bf16_default_matches_oracle_rmse(self):
+        u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=6)
+        x, y = als.als_train((u_ix, i_ix, val), 60, 40, rank=24,
+                             iterations=6, reg=0.05, seed=2)
+        x0, y0 = als.init_factors(60, 40, 24, 2)
+        xo, yo = oracle.als_train(u_ix, i_ix, val, 60, 40, rank=24,
+                                  iterations=6, reg=0.05, x0=x0, y0=y0)
+        ours = als.rmse(x, y, u_ix, i_ix, val)
+        ref = oracle.rmse(xo, yo, u_ix, i_ix, val)
+        assert abs(ours - ref) < 1e-2, (ours, ref)
+
+    def test_implicit_paired_matches_oracle(self):
+        u_ix, i_ix, val = synthetic(40, 30, 3, density=0.4, seed=7)
+        val = np.abs(val)
+        x, y = als.als_train((u_ix, i_ix, val), 40, 30, rank=20,
+                             iterations=5, reg=0.05, implicit=True,
+                             alpha=2.0, seed=3, precision="f32")
+        x0, y0 = als.init_factors(40, 30, 20, 3)
+        xo, yo = oracle.als_train_implicit(
+            u_ix, i_ix, val, 40, 30, rank=20, iterations=5, reg=0.05,
+            alpha=2.0, x0=x0, y0=y0)
+        # implicit has no RMSE; compare reconstructed preference scores
+        np.testing.assert_allclose(x @ y.T, xo @ yo.T, rtol=0.05,
+                                   atol=0.05)
+
+    def test_implicit_paired_bf16_default_matches_oracle(self):
+        u_ix, i_ix, val = synthetic(40, 30, 3, density=0.4, seed=7)
+        val = np.abs(val)
+        x, y = als.als_train((u_ix, i_ix, val), 40, 30, rank=20,
+                             iterations=5, reg=0.05, implicit=True,
+                             alpha=2.0, seed=3)     # default bf16
+        x0, y0 = als.init_factors(40, 30, 20, 3)
+        xo, yo = oracle.als_train_implicit(
+            u_ix, i_ix, val, 40, 30, rank=20, iterations=5, reg=0.05,
+            alpha=2.0, x0=x0, y0=y0)
+        np.testing.assert_allclose(x @ y.T, xo @ yo.T, rtol=0.1,
+                                   atol=0.1)
+
+    def test_solver_residual_surfaced(self):
+        u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=8)
+        tm = {}
+        als.als_train((u_ix, i_ix, val), 60, 40, rank=24, iterations=2,
+                      reg=0.05, timings=tm, precision="f32")
+        assert "solver_residual" in tm
+        assert 0.0 <= tm["solver_residual"] < 1e-2
+
+    def test_nonconvergence_warns(self, caplog):
+        import logging
+        # near-zero reg + rank above the Krylov cap at cg_iters=1
+        u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=9)
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.ops.als"):
+            als.als_train((u_ix, i_ix, val), 60, 40, rank=24,
+                          iterations=2, reg=1e-12, cg_iters=1,
+                          precision="f32")
+        # residual tracking must flag it (warm start can still converge
+        # on easy data, so accept either a warning or a tiny residual)
+        tm = {}
+        als.als_train((u_ix, i_ix, val), 60, 40, rank=24, iterations=2,
+                      reg=1e-12, cg_iters=1, precision="f32", timings=tm)
+        assert caplog.records or tm["solver_residual"] < 1e-2
+
+    def test_sharded_paired_matches_unsharded(self):
+        u, i, v = synthetic(48, 32, 3, density=0.5, seed=10)
+        x0, y0 = als.als_train((u, i, v), 48, 32, rank=24, iterations=3,
+                               reg=0.05, seed=4, precision="f32")
+        x1, y1 = als.als_train((u, i, v), 48, 32, rank=24, iterations=3,
+                               reg=0.05, seed=4, precision="f32",
+                               mesh=make_mesh())
+        np.testing.assert_allclose(x0, x1, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(y0, y1, rtol=2e-3, atol=2e-3)
+
+
 class TestTopK:
     def test_masked_topk_matches_numpy(self):
         rng = np.random.RandomState(0)
@@ -313,7 +403,7 @@ class TestShardedFactorLayout:
         item_side = als._pack_side(i, u, v, 24)
         x0 = jnp.zeros((32, 4), jnp.float32) + 0.1
         y0 = jnp.zeros((24, 4), jnp.float32) + 0.1
-        x_sh, y_sh = als._train_on_mesh(
+        x_sh, y_sh, _ = als._train_on_mesh(
             x0, y0, user_side, item_side, 32, 24, mesh,
             reg=0.05, alpha=1.0, iterations=2, implicit=False, rank=4)
         for arr in (x_sh, y_sh):
