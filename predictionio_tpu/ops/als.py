@@ -228,9 +228,9 @@ def iteration_flops(packed: PackedRatings,
         (2x the useful 2*B*K*R^2 — the off-diagonal blocks of each
         128-wide pair are junk, the price of full 128x128 MXU tiles)
       rhs einsums               : 2*B*K*R
-      warm CG, <= cg_iters per sweep (early exit may do fewer; this is
-      the cap actually compiled): B*cg_iters*(2*R^2 + 8*R) + one
-      warm-start matvec B*2*R^2
+      warm CG (stays in PAIRED form: dense [2R,2R] matvecs, so per row
+      per iteration 4*R^2 mult-adds and 2R-wide vector ops):
+      B*cg_iters*(4*R^2 + 16*R) + warm-start/residual matvecs B*8*R^2
 
     rank <= _SMALL_RANK (exact spd_solve path): Gram 2*B*K*R^2 + rhs +
       Cholesky ~2*(R^3/3 + 2R^2) per row."""
@@ -242,7 +242,8 @@ def iteration_flops(packed: PackedRatings,
             b, k = idx.shape
             if paired:
                 total += 4 * b * k * r * r + 2 * b * k * r
-                total += b * (cg_iters + 1) * (2 * r * r + 8 * r)
+                total += b * cg_iters * (4 * r * r + 16 * r)
+                total += b * 8 * r * r   # warm-start + residual matvecs
             else:
                 total += 2 * b * k * r * r + 2 * b * k * r
                 total += b * 2 * (r ** 3 // 3 + 2 * r * r)
@@ -315,28 +316,81 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
       * Masks are {0,1} so m^2 = m: ONE masked gathered copy serves both
         Gram operands (for implicit, sqrt-confidence weights do the same
         trick), with f32 accumulation via preferred_element_type.
-      * The pair is split back to [B, R, R] before CG so the junk blocks
-        are neither read per CG iteration nor coupled into the solve.
+      * The whole solve stays in PAIRED form: the junk cross blocks of
+        each [2R, 2R] system are zeroed once (fused into the Gram
+        epilogue), which block-diagonalizes the pair so CG solves both
+        halves independently-but-together in 128-wide matvecs.
+        Un-pairing A first was measured SLOWER (a 3.6 GB relayout copy
+        plus worse 64-wide matvec shapes).
       * CG warm-starts from the CURRENT factor rows (inexact ALS:
         block-coordinate descent tolerates approximate solves; measured
-        RMSE matches the exact solve at cg_iters=8 with max residual
-        ~2e-4 on ML-25M). The returned residuals let `als_train` flag
-        non-convergence (low-reg / ill-conditioned systems) instead of
-        going silently wrong.
+        RMSE matches the exact solve at cg_iters=8 on ML-25M). The
+        returned residuals let `als_train` flag non-convergence
+        (low-reg / ill-conditioned systems) instead of going silently
+        wrong.
     """
     import jax.numpy as jnp
 
     from predictionio_tpu.ops.linalg import pcg_solve
 
     R = own.shape[1]
+    B = idx.shape[0]
+    G = B // 2
+    a2, b2, n2 = _paired_normal_eqs(opp_cast, idx, val, msk, reg, alpha,
+                                    yty, implicit=implicit, cast=cast)
+    live2 = n2 > 0                                       # [G, 2R]
+    r2 = rows.reshape(G, 2)
+    safe = jnp.minimum(r2, own.shape[0] - 1)             # _FILL_ROW-safe
+    x0 = jnp.where(live2,
+                   jnp.concatenate([own[safe[:, 0]], own[safe[:, 1]]],
+                                   axis=-1), 0.0)
+    # fixed-trip CG (rtol=0): the early-exit while_loop is a fusion
+    # barrier that measured ~30% on the whole ML-25M step; the residual
+    # still comes back via the extra true-residual matvec. Matvec
+    # precision tracks the Gram precision (see pcg_solve note).
+    mv_prec = (jax.lax.Precision.DEFAULT if cast == jnp.bfloat16
+               else None)
+    x2, rel, _ = pcg_solve(a2, b2, iters=cg_iters, x0=x0, rtol=0.0,
+                           return_info=True, matvec_precision=mv_prec)
+    x2 = jnp.where(live2, x2, 0.0)
+    sol = jnp.stack([x2[:, :R], x2[:, R:]], axis=1).reshape(B, R)
+    rel_b = jnp.broadcast_to(rel[:, None], (G, 2)).reshape(B)
+    return sol, jnp.where(n2.reshape(G, 2, R)[:, :, 0].reshape(B) > 0,
+                          rel_b, 0.0)
+
+
+def _paired_normal_eqs(opp_cast, idx, val, msk, reg, alpha, yty, *,
+                       implicit: bool, cast):
+    """Build the per-PAIR normal equations (A2 [B/2, 2R, 2R] f32
+    block-diagonal, b2 [B/2, 2R] f32, n2 [B/2, 2R] per-lane row counts)
+    through the paired-MXU formulation — the measured-hot
+    gather+Gram+rhs stage, shared by `_solve_slab_paired` and the bench
+    phase breakdown so the roofline numbers measure exactly the
+    production code. The junk cross blocks from pairing are zeroed here
+    (fused by XLA into the einsum epilogue), so each returned system is
+    exactly blockdiag(A_even, A_odd) + ALS-WR diag (identity on empty /
+    padding rows)."""
+    import jax.numpy as jnp
+
+    R = opp_cast.shape[1]
     B, K = idx.shape
     G = B // 2
+    # multiply precision tracks the operand dtype: bf16 operands gain
+    # nothing from multi-pass passes; f32 mode pins HIGHEST so
+    # precision="f32" really is the exact-normal-equations escape hatch
+    prec = (jax.lax.Precision.DEFAULT if cast == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
     i2 = idx.reshape(G, 2, K)
     v2 = val.reshape(G, 2, K)
     m2 = msk.reshape(G, 2, K)
     if implicit:
-        conf_e = alpha * jnp.abs(v2[:, 0]) * m2[:, 0]
-        conf_o = alpha * jnp.abs(v2[:, 1]) * m2[:, 1]
+        # eps keeps c==0 observed entries alive through the sqrt trick:
+        # their A-weight becomes eps (harmless) and the b-weight below
+        # rescales by 1/sqrt(eps), so pref*(1+c)*y is exact even when
+        # alpha == 0 (MLlib allows it: all-equal-confidence model)
+        _EPS = 1e-12
+        conf_e = alpha * jnp.abs(v2[:, 0]) * m2[:, 0] + _EPS * m2[:, 0]
+        conf_o = alpha * jnp.abs(v2[:, 1]) * m2[:, 1] + _EPS * m2[:, 1]
         w_e = jnp.sqrt(conf_e).astype(cast)[..., None]
         w_o = jnp.sqrt(conf_o).astype(cast)[..., None]
     else:
@@ -344,13 +398,12 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
         w_o = m2[:, 1].astype(cast)[..., None]
     ygm = jnp.concatenate([opp_cast[i2[:, 0]] * w_e,
                            opp_cast[i2[:, 1]] * w_o], axis=-1)  # [G,K,2R]
-    a2 = jnp.einsum("gkp,gkq->gpq", ygm, ygm,
+    a2 = jnp.einsum("gkp,gkq->gpq", ygm, ygm, precision=prec,
                     preferred_element_type=jnp.float32)        # [G,2R,2R]
     if implicit:
-        # b weights against the sqrt-conf-weighted copy: pref*(1+c) =
-        # (sqrt(c)) * pref*(1+c)/sqrt(c); c==0 entries contribute 0 to b
-        # in HKV form (pref counts only r > 0, and r > 0 => c > 0)
-        def bw(v, c):   # c = alpha*|v|*m already encodes the mask
+        # b weights against the sqrt-conf-weighted copy:
+        # pref*(1+c) * y = (sqrt(c) * y) * pref*(1+c)/sqrt(c)
+        def bw(v, c):   # c >= eps on observed entries, 0 on padding
             return jnp.where(c > 0, (v > 0) * (1.0 + c) *
                              jax.lax.rsqrt(jnp.maximum(c, 1e-30)), 0.0)
         wb_e = bw(v2[:, 0], conf_e)
@@ -359,23 +412,24 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
         wb_e = v2[:, 0] * m2[:, 0]
         wb_o = v2[:, 1] * m2[:, 1]
     be = jnp.einsum("gkr,gk->gr", ygm[..., :R], wb_e.astype(cast),
-                    preferred_element_type=jnp.float32)
+                    precision=prec, preferred_element_type=jnp.float32)
     bo = jnp.einsum("gkr,gk->gr", ygm[..., R:], wb_o.astype(cast),
-                    preferred_element_type=jnp.float32)
-    # un-pair: [G,2R,2R] diag blocks -> [B,R,R]; [G,2R] -> [B,R]
-    a = jnp.stack([a2[:, :R, :R], a2[:, R:, R:]], axis=1).reshape(B, R, R)
-    b = jnp.stack([be, bo], axis=1).reshape(B, R)
+                    precision=prec, preferred_element_type=jnp.float32)
+    b2 = jnp.concatenate([be, bo], axis=-1)              # [G, 2R]
+    blockmask = np.zeros((2 * R, 2 * R), np.float32)
+    blockmask[:R, :R] = 1.0
+    blockmask[R:, R:] = 1.0
+    a2 = a2 * blockmask
     if implicit:
-        a = a + yty
-    n_row = msk.sum(axis=1)
-    d = reg * n_row + (n_row == 0).astype(jnp.float32)  # pad rows -> I
-    a = a + d[:, None, None] * jnp.eye(R, dtype=jnp.float32)
-    live = (n_row > 0)[:, None]
-    safe = jnp.minimum(rows, own.shape[0] - 1)          # _FILL_ROW-safe
-    x0 = jnp.where(live, own[safe], 0.0)
-    x, rel, _ = pcg_solve(a, b, iters=cg_iters, x0=x0, rtol=1e-5,
-                          return_info=True)
-    return jnp.where(live, x, 0.0), jnp.where(live[:, 0], rel, 0.0)
+        yty2 = jnp.zeros((2 * R, 2 * R), jnp.float32)
+        yty2 = yty2.at[:R, :R].set(yty).at[R:, R:].set(yty)
+        a2 = a2 + yty2
+    n_e, n_o = m2[:, 0].sum(axis=1), m2[:, 1].sum(axis=1)
+    n2 = jnp.concatenate([jnp.repeat(n_e[:, None], R, axis=1),
+                          jnp.repeat(n_o[:, None], R, axis=1)], axis=-1)
+    d2 = reg * n2 + (n2 == 0).astype(jnp.float32)        # pad rows -> I
+    a2 = a2 + d2[:, :, None] * jnp.eye(2 * R, dtype=jnp.float32)
+    return a2, b2, n2
 
 
 def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
@@ -775,10 +829,18 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     slabs_local = ((padded_user + padded_item) * 3 * fb / n_devices
                    * owner_skew)
     gathered_opposite = max(n_users, n_items) * rank * fb
-    slab_gather = min(
+    # Multipliers anchored to the compiler's buffer assignment for the
+    # ML-25M rank-64 program (memory_analysis peak 10.66 GiB, r4 bench):
+    # 2.75x the gather-stage budget (the paired bf16 [G,K,2R] copy, its
+    # two pre-concat producer halves, and cross-slab double-buffering)
+    # and 9x the normal-equation budget (the paired [G,2R,2R] f32 Gram
+    # = 2 budget-units, live twice across slab pipelining, plus CG state
+    # vectors in 2R width). The bench asserts compiler-reported peak <=
+    # this bound.
+    slab_gather = 2.75 * min(
         max(padded_user, padded_item) * rank * fb / n_devices * owner_skew,
         _SLAB_GATHER_BUDGET)
-    normal_bufs = 4 * min(
+    normal_bufs = 9 * min(
         max(n_users, n_items) * rank * rank * fb / n_devices * owner_skew,
         _SLAB_NORMAL_BUDGET)
     persistent = factors_local + slabs_local

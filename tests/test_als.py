@@ -328,12 +328,17 @@ class TestPairedSolver:
         assert caplog.records or tm["solver_residual"] < 1e-2
 
     def test_sharded_paired_matches_unsharded(self):
+        # cg_iters=64 makes the inexact solver effectively exact at rank
+        # 24, so the two paths' trajectories coincide and this isolates
+        # the SHARDING logic (owner partitioning, all-gather, local
+        # scatter) from benign inexact-CG drift
         u, i, v = synthetic(48, 32, 3, density=0.5, seed=10)
         x0, y0 = als.als_train((u, i, v), 48, 32, rank=24, iterations=3,
-                               reg=0.05, seed=4, precision="f32")
+                               reg=0.05, seed=4, precision="f32",
+                               cg_iters=64)
         x1, y1 = als.als_train((u, i, v), 48, 32, rank=24, iterations=3,
                                reg=0.05, seed=4, precision="f32",
-                               mesh=make_mesh())
+                               cg_iters=64, mesh=make_mesh())
         np.testing.assert_allclose(x0, x1, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(y0, y1, rtol=2e-3, atol=2e-3)
 
