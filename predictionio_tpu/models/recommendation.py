@@ -30,7 +30,8 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import RatingColumns
 from predictionio_tpu.ops import als
-from predictionio_tpu.ops.topk import NEG_INF, topk_scores
+from predictionio_tpu.ops.topk import (NEG_INF, topk_scores,
+                                       topk_scores_filtered)
 
 
 # -- queries and results (wire-format parity) -------------------------------
@@ -171,12 +172,24 @@ class ALSAlgorithm(Algorithm):
         n_items = model.item_factors.shape[0]
         k = max(min(q.num, n_items) for _, q, _ in live)
         vecs = model.user_factors[np.array([u for _, _, u in live])]
-        from predictionio_tpu.models.common import resolve_item_mask
-        mask = np.concatenate(
-            [resolve_item_mask(model.items, white_list=q.whiteList,
-                               black_list=q.blackList or ())
-             for _, q, _ in live], axis=0)
-        scores, ixs = topk_scores(vecs, model.item_factors, mask, k=k)
+        if all(q.whiteList is None for _, q, _ in live):
+            # no whitelists: blacklist filtering via the banned-index
+            # device path — the filter is built ON DEVICE from index
+            # lists, so big catalogs do not re-upload a dense mask per
+            # batch (ops/topk.py topk_scores_filtered)
+            banned = [
+                [ix for ix in (model.items.get(b) for b in (q.blackList or ()))
+                 if ix is not None]
+                for _, q, _ in live]
+            scores, ixs = topk_scores_filtered(
+                vecs, model.item_factors, banned, k=k)
+        else:
+            from predictionio_tpu.models.common import resolve_item_mask
+            mask = np.concatenate(
+                [resolve_item_mask(model.items, white_list=q.whiteList,
+                                   black_list=q.blackList or ())
+                 for _, q, _ in live], axis=0)
+            scores, ixs = topk_scores(vecs, model.item_factors, mask, k=k)
         scores, ixs = np.asarray(scores), np.asarray(ixs)
         for row, (i, q, _) in enumerate(live):
             items = []

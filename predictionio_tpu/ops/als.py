@@ -814,12 +814,15 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     paired path, counted at f32 here as the conservative bound), plus
     the per-slab solve transients — the [B, cap, rank] gathered+masked
     factor copy (bf16: cap*rank*2B per row, counted via the gather
-    budget) and ~4x [B, rank, rank] f32 normal-equation buffers (the
-    paired [B/2, 2R, 2R] Gram = 2x a [B, R, R] buffer, its unpaired
-    copy, and CG state), each capped by the slab-split budgets
+    budget at 2.75x for the pre-concat halves and cross-slab
+    double-buffering) and the paired [B/2, 2R, 2R] f32 normal-equation
+    systems that the solve stays in (counted at 9x the normal budget:
+    the Gram is 2 budget-units, live twice across slab pipelining, plus
+    2R-wide CG state), each capped by the slab-split budgets
     (`_SLAB_GATHER_BUDGET` / `_SLAB_NORMAL_BUDGET`), since `_pack_side`
     splits any bucket whose transients would exceed them and XLA's
-    buffer assignment reuses the previous slab's buffers."""
+    buffer assignment reuses the previous slab's buffers. See the
+    multiplier note below for the measured anchor."""
     fb = 4  # f32 / int32 bytes
     pad_side = _BUCKET_BASE + 8
     padded_user = pad_side * n_users + _BUCKET_GROWTH * n_ratings

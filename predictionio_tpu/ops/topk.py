@@ -30,10 +30,24 @@ import numpy as np
 
 NEG_INF = -1e30
 
-# [b, n_items] score cells below which the host path wins. At the
-# crossover the host matmul is ~1 GFLOP-scale work (milliseconds);
-# above it MXU throughput dominates even counting the readback.
-HOST_CROSSOVER_CELLS = 4 << 20
+# [b, n_items] score cells below which the host path wins. Environment-
+# dependent (host BLAS speed x device dispatch overhead): the r4 bench
+# measures it empirically (serve_topk_crossover_cells_measured metric —
+# ~0.8M cells on a tunneled v5e with single-threaded numpy, where device
+# batch-64 scoring is ~1200x the host's). The default stays conservative
+# for fast-host/cold-device setups; operators can pin the measured value
+# via PIO_TOPK_HOST_CROSSOVER_CELLS.
+import os as _os
+
+HOST_CROSSOVER_CELLS = int(_os.environ.get(
+    "PIO_TOPK_HOST_CROSSOVER_CELLS", 4 << 20))
+
+# Dispatch evidence: incremented per call by which path actually served
+# it (the traced/jit path counts as "device" — it compiles into a device
+# program). Read by the bench to PROVE the device path ran, and by tests;
+# plain ints under the GIL (worst case a lost increment, never a wrong
+# path).
+DISPATCH_COUNTS = {"host": 0, "device": 0}
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -81,6 +95,108 @@ def _topk_host(scores: np.ndarray, k: int):
     return np.take_along_axis(scores, ix, axis=1), ix.astype(np.int32)
 
 
+# ---------------------------------------------------------------------------
+# Device-resident model arrays and the banned-index device path.
+#
+# The serving hot loop calls topk with the SAME host factor matrix every
+# time; without caching, each device dispatch re-uploads it (measured:
+# a 500k x 64 catalog is 128 MB -> ~2.5 s/call over a tunneled device,
+# and a real PCIe host still pays ~13 ms/call). `device_resident` uploads
+# once per (array identity) and returns the cached jax.Array.
+# ---------------------------------------------------------------------------
+
+_DEVICE_RESIDENT: dict = {}
+
+
+def device_resident(arr):
+    """Device-put `arr` once and cache by object identity (evicted when
+    the host array is garbage-collected). jax arrays pass through."""
+    import weakref
+
+    if isinstance(arr, (jax.Array, jax.core.Tracer)):
+        return arr
+    key = id(arr)
+    hit = _DEVICE_RESIDENT.get(key)
+    if hit is not None and hit[0]() is arr:
+        return hit[1]
+    dev = jax.device_put(arr)
+    ref = weakref.ref(arr, lambda _, key=key: _DEVICE_RESIDENT.pop(key, None))
+    _DEVICE_RESIDENT[key] = (ref, dev)
+    return dev
+
+
+@partial(jax.jit, static_argnames=("k", "has_bans"))
+def _topk_scores_banned_device(user_vecs, item_factors, banned, *,
+                               k: int, has_bans: bool):
+    scores = jnp.matmul(user_vecs, item_factors.T,
+                        precision=jax.lax.Precision.HIGHEST)
+    if has_bans:
+        rows = jnp.arange(scores.shape[0])[:, None]
+        # out-of-range fill indices (== n_items) are dropped
+        scores = scores.at[rows, banned].set(NEG_INF, mode="drop")
+    return jax.lax.top_k(scores, k)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+def topk_scores_filtered(user_vecs, item_factors, banned_lists, *, k: int):
+    """Top-k scoring with per-query banned-item index lists (blacklist /
+    seen filtering) instead of a dense [b, n_items] mask.
+
+    Host/device dispatch as `topk_scores`, but the device path builds the
+    filter ON DEVICE from a small padded [b, max_banned] index array —
+    uploading a dense bool mask per batch costs b*n_items bytes (32 MB at
+    batch 64 x 500k items) per call, while the index form is a few KB.
+    The factor matrix goes through `device_resident`. Batch and
+    banned-width are padded to powers of two so the jit cache stays at
+    O(log^2) variants instead of one per observed shape.
+
+    Whitelists need the dense-mask form — use `topk_scores` for those.
+    """
+    n_items = item_factors.shape[0]
+    k = min(k, n_items)
+    b = user_vecs.shape[0]
+    cells = b * n_items
+    traced = _is_traced(user_vecs, item_factors)
+    on_dev = _on_device(user_vecs, item_factors)
+    max_banned = max((len(bl) for bl in banned_lists), default=0)
+    wp = _next_pow2(max_banned) if max_banned else 0
+    if not traced and not on_dev and cells < HOST_CROSSOVER_CELLS:
+        mask = np.ones((b, n_items), bool)
+        for row, banned in enumerate(banned_lists):
+            if len(banned):
+                mask[row, np.asarray(banned, int)] = False
+        DISPATCH_COUNTS["host"] += 1
+        scores = np.asarray(user_vecs) @ np.asarray(item_factors).T
+        scores = np.where(mask, scores, np.float32(NEG_INF))
+        return _topk_host(scores, k)
+    DISPATCH_COUNTS["device"] += 1
+    banned_np = np.full((b, max(wp, 1)), n_items, np.int32)
+    for row, bl in enumerate(banned_lists):
+        if len(bl):
+            banned_np[row, :len(bl)] = np.asarray(bl, np.int32)
+    if traced or on_dev:
+        # traced / already-on-device inputs: no host-side padding
+        # round-trip; shapes are what the trace gives us
+        out = _topk_scores_banned_device(
+            user_vecs, item_factors, jnp.asarray(banned_np), k=k,
+            has_bans=wp > 0)
+        return out if traced else jax.device_get(out)
+    # host inputs: pad batch to a power of two to bound jit variants
+    bp = _next_pow2(b)
+    vecs = np.zeros((bp, user_vecs.shape[1]), np.float32)
+    vecs[:b] = user_vecs
+    banned_pad = np.full((bp, max(wp, 1)), n_items, np.int32)
+    banned_pad[:b] = banned_np
+    out = _topk_scores_banned_device(
+        jnp.asarray(vecs), device_resident(item_factors),
+        jnp.asarray(banned_pad), k=k, has_bans=wp > 0)
+    scores, ixs = jax.device_get(out)
+    return scores[:b], ixs[:b]
+
+
 def topk_scores(user_vecs, item_factors, mask, *, k: int):
     """scores = U @ Y^T with invalid items masked out.
 
@@ -95,8 +211,12 @@ def topk_scores(user_vecs, item_factors, mask, *, k: int):
     cells = user_vecs.shape[0] * item_factors.shape[0]
     if traced or _on_device(user_vecs, item_factors) \
             or cells >= HOST_CROSSOVER_CELLS:
+        DISPATCH_COUNTS["device"] += 1
+        if not traced:
+            item_factors = device_resident(item_factors)
         out = _topk_scores_device(user_vecs, item_factors, mask, k=k)
         return out if traced else jax.device_get(out)
+    DISPATCH_COUNTS["host"] += 1
     scores = np.asarray(user_vecs) @ np.asarray(item_factors).T
     scores = np.where(np.asarray(mask), scores, np.float32(NEG_INF))
     return _topk_host(scores, k)
@@ -112,8 +232,12 @@ def topk_similar(query_vecs, item_factors, mask, *, k: int):
     cells = query_vecs.shape[0] * item_factors.shape[0]
     if traced or _on_device(query_vecs, item_factors) \
             or cells >= HOST_CROSSOVER_CELLS:
+        DISPATCH_COUNTS["device"] += 1
+        if not traced:
+            item_factors = device_resident(item_factors)
         out = _topk_similar_device(query_vecs, item_factors, mask, k=k)
         return out if traced else jax.device_get(out)
+    DISPATCH_COUNTS["host"] += 1
     q = np.asarray(query_vecs)
     f = np.asarray(item_factors)
     qn = q / (np.linalg.norm(q, axis=-1, keepdims=True) + 1e-9)

@@ -371,6 +371,50 @@ class TestTopK:
         _, ix = topk_similar(q, y, mask, k=1)
         assert int(ix[0, 0]) != 2
 
+    def test_filtered_matches_masked_host_and_device(self, monkeypatch):
+        from predictionio_tpu.ops import topk as topk_mod
+        rng = np.random.RandomState(2)
+        u = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(60, 8).astype(np.float32)
+        banned = [[3, 7], [], [10, 11, 12], [59]]
+        mask = np.ones((4, 60), bool)
+        for row, bl in enumerate(banned):
+            mask[row, bl] = False
+        ref_s, ref_ix = topk_scores(u, y, mask, k=5)
+        # host path (small problem)
+        s, ix = topk_mod.topk_scores_filtered(u, y, banned, k=5)
+        np.testing.assert_array_equal(ix, ref_ix)
+        # device path (forced via crossover=0), incl. batch padding
+        monkeypatch.setattr(topk_mod, "HOST_CROSSOVER_CELLS", 0)
+        s, ix = topk_mod.topk_scores_filtered(u, y, banned, k=5)
+        np.testing.assert_array_equal(ix, ref_ix)
+        np.testing.assert_allclose(s, ref_s, rtol=1e-6)
+
+    def test_filtered_no_bans_device(self, monkeypatch):
+        from predictionio_tpu.ops import topk as topk_mod
+        rng = np.random.RandomState(3)
+        u = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(17, 4).astype(np.float32)
+        monkeypatch.setattr(topk_mod, "HOST_CROSSOVER_CELLS", 0)
+        s, ix = topk_mod.topk_scores_filtered(u, y, [[], [], []], k=4)
+        ref = np.argsort(-(u @ y.T), axis=1)[:, :4]
+        np.testing.assert_array_equal(ix, ref)
+
+    def test_empty_whitelist_means_nothing_allowed(self):
+        # whiteList=[] must restrict to the empty set (dense-mask path),
+        # not fall through to the unrestricted banned-index path
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm, ALSAlgorithmParams, Query)
+        from predictionio_tpu.ingest.bimap import BiMap
+        algo = ALSAlgorithm(ALSAlgorithmParams())
+        model = als.ALSModel(
+            np.ones((2, 4), np.float32), np.ones((5, 4), np.float32),
+            BiMap.from_keys(["u0", "u1"]),
+            BiMap.from_keys([f"i{n}" for n in range(5)]))
+        out = algo.batch_predict(
+            model, [(0, Query(user="u0", num=3, whiteList=[]))])
+        assert out[0][1].itemScores == ()
+
 
 class TestShardedFactorLayout:
     def test_sharded_implicit_matches_single_device(self):
