@@ -191,10 +191,21 @@ class PgConnection:
 
     def __init__(self, host: str = "localhost", port: int = 5432, *,
                  user: str = "postgres", password: str = "",
-                 database: str = "postgres", timeout: float = 10.0):
+                 database: str = "postgres", timeout: float = 10.0,
+                 allow_cleartext: bool = False):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = b""
         self.user = user
+        # Cleartext password auth (AuthenticationCleartextPassword) sends
+        # the password unencrypted on the socket; a MITM'd or
+        # misconfigured server could harvest it. Allowed only on loopback
+        # (where there is no wire to tap) unless explicitly opted in —
+        # md5 and SCRAM stay available everywhere.
+        try:
+            peer = self.sock.getpeername()[0]
+        except OSError:
+            peer = ""
+        self._cleartext_ok = allow_cleartext or peer in ("127.0.0.1", "::1")
         self.sock.sendall(encode_startup(user, database))
         self._authenticate(password)
         # drain until ReadyForQuery
@@ -239,6 +250,14 @@ class PgConnection:
             if code == 0:
                 return
             if code == 3:
+                if not self._cleartext_ok:
+                    raise PgError({
+                        "M": "server requested cleartext password "
+                             "authentication over a non-loopback "
+                             "connection; refusing (pass "
+                             "allow_cleartext=True / set "
+                             "PIO_STORAGE_SOURCES_<N>_ALLOW_CLEARTEXT "
+                             "to override)", "C": ""})
                 self.sock.sendall(encode_password(password))
             elif code == 5:
                 self.sock.sendall(encode_md5_password(

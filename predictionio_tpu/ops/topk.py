@@ -164,14 +164,13 @@ def topk_scores_filtered(user_vecs, item_factors, banned_lists, *, k: int):
     max_banned = max((len(bl) for bl in banned_lists), default=0)
     wp = _next_pow2(max_banned) if max_banned else 0
     if not traced and not on_dev and cells < HOST_CROSSOVER_CELLS:
+        # small problems: densify the filter and delegate so the host
+        # scoring/tie-breaking path exists in exactly one place
         mask = np.ones((b, n_items), bool)
         for row, banned in enumerate(banned_lists):
             if len(banned):
                 mask[row, np.asarray(banned, int)] = False
-        DISPATCH_COUNTS["host"] += 1
-        scores = np.asarray(user_vecs) @ np.asarray(item_factors).T
-        scores = np.where(mask, scores, np.float32(NEG_INF))
-        return _topk_host(scores, k)
+        return topk_scores(user_vecs, item_factors, mask, k=k)
     DISPATCH_COUNTS["device"] += 1
     banned_np = np.full((b, max(wp, 1)), n_items, np.int32)
     for row, bl in enumerate(banned_lists):

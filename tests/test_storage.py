@@ -72,6 +72,11 @@ def make_registry(kind: str, tmpdir: str) -> StorageRegistry:
         cfg = {"PIO_STORAGE_SOURCES_PG_TYPE": "POSTGRES",
                "PIO_STORAGE_SOURCES_PG_URL": postgres_url()}
         src = "PG"
+    elif kind == "POSTGRES-FAKE":
+        # URL injected by the fixture from the running FakePgServer
+        cfg = {"PIO_STORAGE_SOURCES_PG_TYPE": "POSTGRES",
+               "PIO_STORAGE_SOURCES_PG_URL": tmpdir}
+        src = "PG"
     for repo in ("METADATA", "EVENTDATA"):
         cfg.setdefault(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", src)
     return StorageRegistry(cfg)
@@ -80,19 +85,32 @@ def make_registry(kind: str, tmpdir: str) -> StorageRegistry:
 BACKENDS = [
     "MEM", "SQLITE", "SQLITE+LOCALFS", "SQLITE+EVLOG",
     "SQLITE+OBJECTSTORE",
-    pytest.param("POSTGRES", marks=pytest.mark.skipif(
-        postgres_url() is None,
-        reason="no Postgres server (set PIO_TEST_POSTGRES_URL or run one "
-               "on 127.0.0.1:5432)")),
+    # POSTGRES always runs: against a live server when one is available,
+    # otherwise against tests/fakepg.py — a loopback v3-protocol server
+    # that exercises the REAL pgwire socket path (startup, SCRAM, the
+    # extended protocol, SQLSTATE error mapping)
+    "POSTGRES",
 ]
 
 
 @pytest.fixture(params=BACKENDS)
 def registry(request):
     with tempfile.TemporaryDirectory() as d:
-        reg = make_registry(request.param, d)
         if request.param == "POSTGRES":
-            _pg_wipe(reg)
+            live = postgres_url()
+            if live is not None:
+                reg = make_registry("POSTGRES", d)
+                _pg_wipe(reg)
+                yield reg
+                reg.close()
+            else:
+                from tests.fakepg import FakePgServer
+                with FakePgServer() as url:
+                    reg = make_registry("POSTGRES-FAKE", url)
+                    yield reg
+                    reg.close()
+            return
+        reg = make_registry(request.param, d)
         yield reg
         reg.close()
 
