@@ -67,6 +67,15 @@ def _register_builtin_drivers() -> None:
         "Events": evlog.EvlogEvents,
     })
 
+    # the scalable INDEXED event store: time-bucketed segment journals
+    # with minmax + entity-bloom sidecar indexes, so find() prunes
+    # segments instead of scanning (the HBase rowkey-design role,
+    # HBEventsUtil.scala:54-110)
+    from predictionio_tpu.data.storage import pevlog
+    register_driver("PEVLOG", pevlog.PevlogStorageClient, {
+        "Events": pevlog.PevlogEvents,
+    })
+
     # networked SQL backend (the reference's jdbc/PGSQL driver set);
     # the wire connection is only opened when the source is used
     for type_name in ("POSTGRES", "PGSQL"):

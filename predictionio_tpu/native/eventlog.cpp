@@ -53,6 +53,34 @@ uint32_t get_u32(const uint8_t* p) {
          ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
 }
 
+// Full write with short-write retry.
+bool write_all(int fd, const uint8_t* buf, size_t len) {
+  size_t done = 0;
+  while (done < len) {
+    ssize_t n = write(fd, buf + done, len - done);
+    if (n <= 0) return false;
+    done += (size_t)n;
+  }
+  return true;
+}
+
+// Shared locked-append: open O_APPEND, take the exclusive lock, write
+// both spans fully, fsync. Returns the start offset, or -1.
+long long locked_append(const char* path, const uint8_t* head,
+                        size_t head_len, const uint8_t* body,
+                        size_t body_len) {
+  int fd = open(path, O_WRONLY | O_APPEND | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  if (flock(fd, LOCK_EX) != 0) { close(fd); return -1; }
+  off_t offset = lseek(fd, 0, SEEK_END);
+  bool ok = (head_len == 0 || write_all(fd, head, head_len)) &&
+            (body_len == 0 || write_all(fd, body, body_len));
+  if (ok && fsync(fd) != 0) ok = false;
+  flock(fd, LOCK_UN);
+  close(fd);
+  return ok ? (long long)offset : -1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -60,20 +88,21 @@ extern "C" {
 // Append one payload; returns the frame's file offset, or -1 on error.
 long long el_append(const char* path, const uint8_t* buf, long long len) {
   if (len < 0) return -1;
-  int fd = open(path, O_WRONLY | O_APPEND | O_CREAT, 0644);
-  if (fd < 0) return -1;
-  if (flock(fd, LOCK_EX) != 0) { close(fd); return -1; }
-  off_t offset = lseek(fd, 0, SEEK_END);
   uint8_t header[kHeader];
   put_u32(header, kMagic);
   put_u32(header + 4, (uint32_t)len);
   put_u32(header + 8, crc32(buf, (size_t)len));
-  bool ok = write(fd, header, kHeader) == (ssize_t)kHeader &&
-            write(fd, buf, (size_t)len) == (ssize_t)len;
-  if (ok && fsync(fd) != 0) ok = false;
-  flock(fd, LOCK_UN);
-  close(fd);
-  return ok ? (long long)offset : -1;
+  return locked_append(path, header, kHeader, buf, (size_t)len);
+}
+
+// Append a pre-framed blob (a concatenation of valid frames built by the
+// caller) in ONE write under the exclusive lock — the bulk-ingest path
+// (one lock/fsync per batch instead of per event). Returns the blob's
+// file offset, or -1 on error.
+long long el_append_blob(const char* path, const uint8_t* buf,
+                         long long len) {
+  if (len < 0) return -1;
+  return locked_append(path, nullptr, 0, buf, (size_t)len);
 }
 
 // Fill offsets[]/lengths[] (payload offsets, i.e. past the header) for up

@@ -60,6 +60,13 @@ def make_registry(kind: str, tmpdir: str) -> StorageRegistry:
                "PIO_STORAGE_SOURCES_EV_PATH": str(Path(tmpdir) / "evlog"),
                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV"}
         src = "SQLITE"
+    elif kind == "SQLITE+PEVLOG":
+        cfg = {"PIO_STORAGE_SOURCES_SQLITE_TYPE": "SQLITE",
+               "PIO_STORAGE_SOURCES_SQLITE_PATH": str(Path(tmpdir) / "pio.db"),
+               "PIO_STORAGE_SOURCES_PEV_TYPE": "PEVLOG",
+               "PIO_STORAGE_SOURCES_PEV_PATH": str(Path(tmpdir) / "pevlog"),
+               "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PEV"}
+        src = "SQLITE"
     elif kind == "SQLITE+OBJECTSTORE":
         cfg = {"PIO_STORAGE_SOURCES_SQLITE_TYPE": "SQLITE",
                "PIO_STORAGE_SOURCES_SQLITE_PATH": str(Path(tmpdir) / "pio.db"),
@@ -83,7 +90,7 @@ def make_registry(kind: str, tmpdir: str) -> StorageRegistry:
 
 
 BACKENDS = [
-    "MEM", "SQLITE", "SQLITE+LOCALFS", "SQLITE+EVLOG",
+    "MEM", "SQLITE", "SQLITE+LOCALFS", "SQLITE+EVLOG", "SQLITE+PEVLOG",
     "SQLITE+OBJECTSTORE",
     # POSTGRES always runs: against a live server when one is available,
     # otherwise against tests/fakepg.py — a loopback v3-protocol server
