@@ -32,7 +32,7 @@ from predictionio_tpu.data import store
 from predictionio_tpu.ingest import BiMap, RatingColumns
 from predictionio_tpu.ops import als
 from predictionio_tpu.ops.cooccur import (
-    CooccurrenceModel, cooccurrence_matrix, top_cooccurrences,
+    CooccurrenceModel, top_cooccurrences_from_pairs,
 )
 from predictionio_tpu.ops.topk import NEG_INF, topk_similar
 
@@ -207,6 +207,9 @@ class LikeAlgorithm(_FactorSimilarityAlgorithm):
 @dataclass(frozen=True)
 class CooccurrenceParams(Params):
     n: int = 20   # cooccurrences kept per item
+    # optional per-user distinct-item cap (Mahout --maxPrefsPerUser);
+    # None = exact parity with the reference self-join
+    max_items_per_user: Optional[int] = None
 
 
 @dataclass
@@ -224,10 +227,11 @@ class CooccurrenceAlgorithm(Algorithm):
 
     def train(self, ctx: RuntimeContext, pd: TrainingData) -> CoocModel:
         views = pd.views
-        c = cooccurrence_matrix(views.user_ix, views.item_ix,
-                                len(views.users), len(views.items))
-        return CoocModel(top_cooccurrences(c, self.params.n),
-                         views.items, pd.item_categories)
+        top = top_cooccurrences_from_pairs(
+            views.user_ix, views.item_ix,
+            len(views.users), len(views.items), self.params.n,
+            max_items_per_user=self.params.max_items_per_user)
+        return CoocModel(top, views.items, pd.item_categories)
 
     def predict(self, model: CoocModel, query: Query) -> PredictedResult:
         n_items = len(model.items)

@@ -5,17 +5,36 @@ Replaces the similarproduct template's RDD self-join
 scala/CooccurrenceAlgorithm.scala:47-110`): count users who interacted
 with both items i and j, keep the top-N cooccurring items per item.
 
-TPU formulation: with A the {0,1} user x item interaction matrix,
-the cooccurrence matrix is C = A^T A — an MXU matmul, accumulated over
-user chunks so memory stays bounded. The reference's shuffle-heavy
-self-join becomes one matmul chain.
+Two regimes:
+
+* Template scale (`cooccurrence_matrix`): with A the {0,1} user x item
+  interaction matrix, C = A^T A — an MXU matmul accumulated over user
+  chunks. Materializes the dense [n_items, n_items] matrix, so it is
+  only used below `_DENSE_ITEM_LIMIT` items.
+
+* Catalog scale (`top_cooccurrences_streaming`): never materializes
+  n^2. Items are processed in row blocks; for each block the COMPLETE
+  rows C[b0:b0+B, :] are built by scatter-adding, for every (user,
+  item-in-block) pair, +1 at the columns of that user's full item
+  list, then reduced to the per-row top-N before the next block. The
+  per-row top-N is exact because each block is fully accumulated
+  before reduction. Work is the sparse self-join cost
+  sum_u d_u^2 (the reference's shuffle volume), not the dense
+  2*U*I^2 matmul FLOPs, and peak memory is
+  [row_block, n_items+1] + the degree-bucketed per-user item lists —
+  the same padded-bucket discipline as `ops/als.py`.
+
+Heavy users dominate sum_u d_u^2, so `max_items_per_user` optionally
+caps each user's distinct items by deterministic subsample (the same
+knob Mahout's ItemSimilarityJob exposes as --maxPrefsPerUser). Default
+is uncapped: exact parity with the reference self-join.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,3 +88,164 @@ def top_cooccurrences(cooccur: np.ndarray, n: int) -> CooccurrenceModel:
     counts, items = jax.lax.top_k(c, k)
     return CooccurrenceModel(np.asarray(items, np.int32),
                              np.asarray(counts, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# streaming (catalog-scale) path
+# ---------------------------------------------------------------------------
+
+# above this many items the dense [n_items, n_items] counts matrix
+# (f32) would cross 64 MiB and the router switches to streaming
+_DENSE_ITEM_LIMIT = 4096
+
+# default HBM budget for the [row_block, n_items+1] block accumulator
+_BLOCK_BUDGET_BYTES = 256 * 1024 * 1024
+
+# pairs scatter-added per compiled step; fixed so one program is
+# compiled per degree bucket regardless of block pair counts
+_PAIR_CHUNK = 8192
+
+# per-user item-list buckets: x2 ladder from 8, same padding-bound idea
+# as the ALS degree buckets (ops/als.py _cap_ladder)
+_USER_BUCKET_BASE = 8
+
+
+def _user_buckets(degrees: np.ndarray) -> List[int]:
+    caps = [_USER_BUCKET_BASE]
+    dmax = int(degrees.max()) if degrees.size else 1
+    while caps[-1] < dmax:
+        caps.append(caps[-1] * 2)
+    return caps
+
+
+@partial(jax.jit, static_argnames=("n_cols",), donate_argnums=(0,))
+def _scatter_block(c_b, rows_local, cols, valid, n_cols):
+    """c_b[rows_local[p], cols[p, s]] += valid[p] for every pair p and
+    item slot s. Sentinel cols (== n_cols-1) land in the dump column."""
+    del n_cols
+    upd = jnp.broadcast_to(valid[:, None].astype(c_b.dtype), cols.shape)
+    return c_b.at[rows_local[:, None], cols].add(upd)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _block_topk(c_b, b0, k):
+    """Top-k of the complete block rows, self-column zeroed."""
+    n_items = c_b.shape[1] - 1
+    c = c_b[:, :n_items]
+    rows = jnp.arange(c.shape[0])
+    c = c.at[rows, jnp.minimum(b0 + rows, n_items - 1)].set(0.0)
+    return jax.lax.top_k(c, k)
+
+
+def _cap_users(pairs: np.ndarray, cap: int, seed: int) -> np.ndarray:
+    """Deterministically subsample each user's distinct items to `cap`
+    (Mahout ItemSimilarityJob --maxPrefsPerUser)."""
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(pairs))
+    shuffled = pairs[order]
+    # stable sort by user restores user grouping but in shuffled item
+    # order, so keeping the first `cap` rows per user is a uniform sample
+    shuffled = shuffled[np.argsort(shuffled[:, 0], kind="stable")]
+    seg_start = np.r_[0, np.flatnonzero(np.diff(shuffled[:, 0])) + 1]
+    rank_in_user = np.arange(len(shuffled)) - np.repeat(
+        seg_start, np.diff(np.r_[seg_start, len(shuffled)]))
+    return shuffled[rank_in_user < cap]
+
+
+def top_cooccurrences_streaming(
+        user_ix: np.ndarray, item_ix: np.ndarray,
+        n_users: int, n_items: int, n: int, *,
+        row_block: Optional[int] = None,
+        max_items_per_user: Optional[int] = None,
+        seed: int = 0,
+        block_budget_bytes: int = _BLOCK_BUDGET_BYTES) -> CooccurrenceModel:
+    """Exact per-item top-N cooccurrences without the dense n^2 matrix.
+
+    Peak device memory is [row_block, n_items+1] f32 plus the bucketed
+    per-user item lists — never [n_items, n_items]. With no
+    `max_items_per_user` the result is bit-identical to
+    `top_cooccurrences(cooccurrence_matrix(...), n)`.
+    """
+    del n_users
+    k = min(n, n_items)
+    pairs = np.unique(np.stack([np.asarray(user_ix, np.int64),
+                                np.asarray(item_ix, np.int64)], axis=1),
+                      axis=0)
+    if max_items_per_user is not None:
+        pairs = _cap_users(pairs, max_items_per_user, seed)
+        pairs = pairs[np.lexsort((pairs[:, 1], pairs[:, 0]))]
+    if row_block is None:
+        row_block = int(block_budget_bytes // (4 * (n_items + 1)))
+        row_block = max(64, min(n_items, (row_block // 8) * 8))
+
+    top_items = np.zeros((n_items, k), np.int32)
+    top_counts = np.zeros((n_items, k), np.float32)
+    if not len(pairs):
+        return CooccurrenceModel(top_items, top_counts)
+
+    # --- bucket users by degree; per bucket: padded item lists + that
+    # bucket's pairs sorted by item with bucket-local user ids ---------
+    uniq_users, user_pos, degrees = np.unique(
+        pairs[:, 0], return_inverse=True, return_counts=True)
+    buckets = []   # (items_pad [n_b, cap] device, by_item_pairs [m_b, 2])
+    for cap in _user_buckets(degrees):
+        in_b = ((degrees <= cap)
+                & (degrees > (cap // 2 if cap > _USER_BUCKET_BASE else 0)))
+        sel = np.flatnonzero(in_b)
+        if not len(sel):
+            continue
+        local_of = np.full(len(uniq_users), -1, np.int64)
+        local_of[sel] = np.arange(len(sel))
+        mask = local_of[user_pos] >= 0
+        bp = pairs[mask]
+        blocal = local_of[user_pos[mask]]
+        # pairs arrive user-sorted, so slots fill in item order per user
+        items_pad = np.full((len(sel), cap), n_items, np.int32)
+        slot = np.arange(len(bp)) - np.repeat(
+            np.r_[0, np.flatnonzero(np.diff(blocal)) + 1],
+            np.diff(np.r_[0, np.flatnonzero(np.diff(blocal)) + 1, len(bp)]))
+        items_pad[blocal, slot] = bp[:, 1]
+        order = np.argsort(bp[:, 1], kind="stable")
+        by_item = np.stack([blocal[order], bp[order, 1]], axis=1)
+        buckets.append((jnp.asarray(items_pad), by_item))
+
+    # --- stream row blocks: full accumulation, then exact top-k -------
+    for b0 in range(0, n_items, row_block):
+        bsz = min(row_block, n_items - b0)
+        todo = [(ip, bi[np.searchsorted(bi[:, 1], b0):
+                        np.searchsorted(bi[:, 1], b0 + bsz)])
+                for ip, bi in buckets]
+        if not any(len(t[1]) for t in todo):
+            continue   # no events touch this block: rows stay zero
+        c_b = jnp.zeros((row_block, n_items + 1), jnp.float32)
+        for items_pad, blk in todo:
+            for s in range(0, len(blk), _PAIR_CHUNK):
+                ch = blk[s:s + _PAIR_CHUNK]
+                pad = _PAIR_CHUNK - len(ch)
+                rows_local = jnp.asarray(
+                    np.r_[ch[:, 1] - b0, np.zeros(pad, np.int64)], jnp.int32)
+                users = jnp.asarray(
+                    np.r_[ch[:, 0], np.zeros(pad, np.int64)], jnp.int32)
+                valid = jnp.asarray(
+                    np.r_[np.ones(len(ch), bool), np.zeros(pad, bool)])
+                c_b = _scatter_block(c_b, rows_local,
+                                     items_pad[users], valid, n_items + 1)
+        counts, items = _block_topk(c_b, jnp.int32(b0), k)
+        top_counts[b0:b0 + bsz] = np.asarray(counts[:bsz], np.float32)
+        top_items[b0:b0 + bsz] = np.asarray(items[:bsz], np.int32)
+    return CooccurrenceModel(top_items, top_counts)
+
+
+def top_cooccurrences_from_pairs(
+        user_ix: np.ndarray, item_ix: np.ndarray,
+        n_users: int, n_items: int, n: int, *,
+        max_items_per_user: Optional[int] = None,
+        seed: int = 0) -> CooccurrenceModel:
+    """Route by catalog size: dense MXU matmul below `_DENSE_ITEM_LIMIT`
+    items, streaming row blocks above (no n^2 allocation)."""
+    if n_items <= _DENSE_ITEM_LIMIT and max_items_per_user is None:
+        c = cooccurrence_matrix(user_ix, item_ix, n_users, n_items)
+        return top_cooccurrences(c, n)
+    return top_cooccurrences_streaming(
+        user_ix, item_ix, n_users, n_items, n,
+        max_items_per_user=max_items_per_user, seed=seed)

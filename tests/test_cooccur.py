@@ -1,0 +1,126 @@
+"""Streaming cooccurrence: exact parity with the dense matmul path and
+the no-dense-n^2 memory discipline at catalog scale.
+
+Reference behavior: CooccurrenceAlgorithm.scala:47-110 (per-user
+distinct item sets, top-N cooccurring items per item). The cap knob
+mirrors Mahout ItemSimilarityJob --maxPrefsPerUser.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import cooccur
+from predictionio_tpu.ops.cooccur import (
+    cooccurrence_matrix, top_cooccurrences, top_cooccurrences_from_pairs,
+    top_cooccurrences_streaming,
+)
+
+
+def _random_pairs(rng, n_users, n_items, n_events):
+    u = rng.randint(0, n_users, n_events)
+    i = rng.randint(0, n_items, n_events)
+    return u, i
+
+
+class TestStreamingParity:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_matches_dense_exactly(self, seed):
+        rng = np.random.RandomState(seed)
+        n_users, n_items = 40, 50
+        u, i = _random_pairs(rng, n_users, n_items, 600)
+        dense = top_cooccurrences(
+            cooccurrence_matrix(u, i, n_users, n_items), 7)
+        # row_block small + tiny pair chunk exercises block boundaries
+        # and the chunked scatter padding
+        old_chunk = cooccur._PAIR_CHUNK
+        cooccur._PAIR_CHUNK = 16
+        try:
+            stream = top_cooccurrences_streaming(
+                u, i, n_users, n_items, 7, row_block=8)
+        finally:
+            cooccur._PAIR_CHUNK = old_chunk
+        np.testing.assert_array_equal(dense.top_counts, stream.top_counts)
+        # items may differ only where counts tie at zero; compare where
+        # a real count exists
+        nz = dense.top_counts > 0
+        np.testing.assert_array_equal(dense.top_items[nz],
+                                      stream.top_items[nz])
+
+    def test_duplicate_events_count_once(self):
+        # same user views item 0 three times and item 1 once: count 1
+        u = np.array([5, 5, 5, 5])
+        i = np.array([0, 0, 0, 1])
+        m = top_cooccurrences_streaming(u, i, 10, 3, 2)
+        assert m.top_counts[0, 0] == 1.0 and m.top_items[0, 0] == 1
+        assert m.top_counts[1, 0] == 1.0 and m.top_items[1, 0] == 0
+
+    def test_empty_events(self):
+        m = top_cooccurrences_streaming(
+            np.array([], np.int64), np.array([], np.int64), 0, 5, 3)
+        assert m.top_items.shape == (5, 3)
+        assert not m.top_counts.any()
+
+
+class TestRouter:
+    def test_small_catalog_routes_dense(self, monkeypatch):
+        called = {}
+        real = cooccur.cooccurrence_matrix
+
+        def spy(*a, **k):
+            called["dense"] = True
+            return real(*a, **k)
+        monkeypatch.setattr(cooccur, "cooccurrence_matrix", spy)
+        top_cooccurrences_from_pairs(
+            np.array([0, 0]), np.array([0, 1]), 1, 2, 1)
+        assert called.get("dense")
+
+    def test_large_catalog_never_builds_dense(self, monkeypatch):
+        """59k-item catalog (the ML-25M shape the verdict flagged):
+        routed to streaming, and the dense n^2 constructor must never
+        run — peak accumulator is [row_block, n_items+1]."""
+        def boom(*a, **k):
+            raise AssertionError("dense n^2 path used at catalog scale")
+        monkeypatch.setattr(cooccur, "cooccurrence_matrix", boom)
+        n_items = 59_000
+        rng = np.random.RandomState(0)
+        # events concentrated on a handful of items: blocks without
+        # events are skipped host-side, so the test stays fast while
+        # the catalog (and so the would-be n^2) is full size
+        u = rng.randint(0, 200, 3000)
+        i = np.concatenate([rng.randint(0, 40, 2800),
+                            rng.randint(58_990, n_items, 200)])
+        m = top_cooccurrences_from_pairs(u, i, 200, n_items, 10)
+        assert m.top_items.shape == (n_items, 10)
+        assert m.top_counts[:40].any()          # populated head block
+        assert m.top_counts[58_990:].any()      # populated tail block
+        assert not m.top_counts[1000:58_000].any()   # untouched middle
+
+    def test_cap_routes_streaming_and_bounds_degree(self):
+        # one user touching every item; cap=4 keeps 4 distinct items so
+        # no count can exceed the capped co-visit set
+        n_items = 30
+        u = np.zeros(n_items, np.int64)
+        i = np.arange(n_items, dtype=np.int64)
+        m = top_cooccurrences_from_pairs(
+            u, i, 1, n_items, 5, max_items_per_user=4)
+        assert (m.top_counts > 0).sum() == 4 * 3   # 4 items x 3 others
+
+
+class TestCapSampling:
+    def test_cap_is_deterministic_and_uniformish(self):
+        pairs = np.stack([np.zeros(100, np.int64),
+                          np.arange(100, dtype=np.int64)], axis=1)
+        a = cooccur._cap_users(pairs, 10, seed=3)
+        b = cooccur._cap_users(pairs, 10, seed=3)
+        np.testing.assert_array_equal(a, b)
+        assert len(a) == 10
+        c = cooccur._cap_users(pairs, 10, seed=4)
+        assert set(map(tuple, a)) != set(map(tuple, c))
+
+    def test_cap_noop_below_cap(self):
+        rng = np.random.RandomState(0)
+        u, i = _random_pairs(rng, 20, 15, 100)
+        pairs = np.unique(np.stack([u, i], axis=1), axis=0)
+        capped = cooccur._cap_users(pairs, 50, seed=0)
+        capped = capped[np.lexsort((capped[:, 1], capped[:, 0]))]
+        np.testing.assert_array_equal(pairs, capped)
