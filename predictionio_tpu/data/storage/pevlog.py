@@ -22,9 +22,23 @@ supplied ids without the prefix still work via full scan.
 Sidecar indexes are rebuildable caches: each records the journal byte
 size it summarizes ("synced"); a mismatch (crash between append and
 index flush, or external appends) triggers a rebuild from the journal —
-the journal is always the source of truth. Deletes append tombstone
-frames to a per-partition `tombstones.log` that is always replayed
-(deletes are rare; segment immutability is what buys the pruning).
+the journal is always the source of truth. Coverage is computed from the
+append's returned byte offsets, never a post-append stat(), so a
+concurrent flock'd writer interleaving between index snapshot and append
+forces a rebuild instead of silently under-indexed coverage.
+
+Deletes append timed tombstone frames to a per-partition
+`tombstones.log` that is always replayed (deletes are rare; segment
+immutability is what buys the pruning). An event frame is dead iff a
+tombstone for its id carries a deletion time >= the frame's creation
+time — so delete-then-reinsert resurrects the id (EVLOG parity) and the
+stale frame in the original segment stays dead.
+
+Externally supplied ids are recorded in a per-partition
+`external_ids.log` (id -> bucket), giving cross-bucket duplicate
+detection and targeted get() without full scans; generated ids are
+uuid-fresh and live in their prefix segment, so a fast-path miss on a
+generated-shape id is authoritative.
 
 Config: PIO_STORAGE_SOURCES_<N>_TYPE=PEVLOG, ..._PATH=<dir>,
 ..._BUCKET_HOURS=<int, default 24>.
@@ -34,9 +48,12 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 import threading
 import uuid as uuidlib
 from base64 import b64decode, b64encode
+from dataclasses import replace
+from datetime import datetime, timezone
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
@@ -45,7 +62,7 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.evlog import (
     _from_us, _payload_to_event, _us,
 )
-from predictionio_tpu.native.eventlog import EventLog
+from predictionio_tpu.native.eventlog import EventLog, framed_size
 
 
 def _compact_payload(e: Event) -> bytes:
@@ -82,41 +99,85 @@ def _decode_payload(obj: dict) -> Event:
         event_id=obj["id"], tags=tuple(obj.get("g", ())),
         pr_id=obj.get("pr"))
 
-_BLOOM_BITS = 1 << 16          # 8 KiB per segment
+_BLOOM_BITS = 1 << 16          # initial size: 8 KiB per segment
 _BLOOM_HASHES = 4
+# grow the filter when more than 1/_BLOOM_MAX_FILL of its bits are set
+# (fp rate at 1/3 fill with 4 hashes ~ 1.2%); a fixed 64k-bit filter
+# saturates around ~20k entities per segment, silently disabling the
+# pruning that is this driver's whole point
+_BLOOM_MAX_FILL = 3
+# ~16 bits per expected entity keeps fill ~ 0.22 after sizing
+_BLOOM_BITS_PER_ENTITY = 16
 _IDX_FLUSH_EVERY = 256         # appends between index persists
 
 
-def _bloom_positions(entity_type: str, entity_id: str) -> List[int]:
+def _bloom_bits_for(n: int) -> int:
+    bits = _BLOOM_BITS
+    while bits < _BLOOM_BITS_PER_ENTITY * max(1, n):
+        bits *= 2
+    return bits
+
+
+def _bloom_positions(entity_type: str, entity_id: str,
+                     bits: int) -> List[int]:
     digest = hashlib.md5(
         f"{entity_type}\x00{entity_id}".encode()).digest()
-    return [int.from_bytes(digest[i * 4:i * 4 + 4], "little") % _BLOOM_BITS
+    return [int.from_bytes(digest[i * 4:i * 4 + 4], "little") % bits
             for i in range(_BLOOM_HASHES)]
 
 
 class _SegmentIndex:
     """min/max event time + entity Bloom for one segment journal."""
 
-    def __init__(self):
+    def __init__(self, bits: int = _BLOOM_BITS):
         self.min_us = None
         self.max_us = None
         self.count = 0
         self.synced = 0          # journal bytes the PERSISTED idx covers
-        self.bloom = bytearray(_BLOOM_BITS // 8)
+        self.bits = bits
+        self.filled = 0          # set bits (saturation tracking)
+        self.bloom = bytearray(bits // 8)
         self.dirty = 0           # appends since last persist
         self.mem_size = 0        # journal bytes the in-memory state covers
+
+    def _bloom_add(self, entity_type: str, entity_id: str) -> None:
+        for pos in _bloom_positions(entity_type, entity_id, self.bits):
+            byte, bit = pos // 8, 1 << (pos % 8)
+            if not self.bloom[byte] & bit:
+                self.bloom[byte] |= bit
+                self.filled += 1
 
     def add(self, ev: Event) -> None:
         t = _us(ev.event_time)
         self.min_us = t if self.min_us is None else min(self.min_us, t)
         self.max_us = t if self.max_us is None else max(self.max_us, t)
         self.count += 1
-        for pos in _bloom_positions(ev.entity_type, ev.entity_id):
-            self.bloom[pos // 8] |= 1 << (pos % 8)
+        self._bloom_add(ev.entity_type, ev.entity_id)
 
     def may_contain(self, entity_type: str, entity_id: str) -> bool:
         return all(self.bloom[p // 8] & (1 << (p % 8))
-                   for p in _bloom_positions(entity_type, entity_id))
+                   for p in _bloom_positions(entity_type, entity_id,
+                                             self.bits))
+
+    @property
+    def bloom_saturated(self) -> bool:
+        return self.filled * _BLOOM_MAX_FILL > self.bits
+
+    def with_grown_bloom(self, events) -> "_SegmentIndex":
+        """A NEW index with a filter resized for `events` (this object
+        is never mutated: concurrent lock-free readers keep seeing the
+        old filter, which is monotonic — saturated-but-correct. The
+        caller swaps the new object into the index cache, an atomic
+        dict assignment)."""
+        events = list(events)
+        ix = _SegmentIndex(
+            bits=max(_bloom_bits_for(len(events)), self.bits * 2))
+        ix.min_us, ix.max_us = self.min_us, self.max_us
+        ix.count, ix.synced = self.count, self.synced
+        ix.mem_size, ix.dirty = self.mem_size, self.dirty
+        for ev in events:
+            ix._bloom_add(ev.entity_type, ev.entity_id)
+        return ix
 
     def overlaps(self, start_us: Optional[int],
                  until_us: Optional[int]) -> bool:
@@ -131,6 +192,7 @@ class _SegmentIndex:
     def dump(self) -> dict:
         return {"min_us": self.min_us, "max_us": self.max_us,
                 "count": self.count, "synced": self.synced,
+                "bits": self.bits,
                 "bloom": b64encode(bytes(self.bloom)).decode()}
 
     @classmethod
@@ -141,6 +203,8 @@ class _SegmentIndex:
         ix.count = obj["count"]
         ix.synced = obj["synced"]
         ix.bloom = bytearray(b64decode(obj["bloom"]))
+        ix.bits = obj.get("bits", len(ix.bloom) * 8)
+        ix.filled = int.from_bytes(bytes(ix.bloom), "little").bit_count()
         return ix
 
 
@@ -150,8 +214,11 @@ class PevlogStorageClient:
         self.base_dir.mkdir(parents=True, exist_ok=True)
         self.bucket_us = int(config.get("BUCKET_HOURS", 24)) * 3600 * 1_000_000
         self.lock = threading.RLock()
-        # seg path -> (size snapshot, {event_id: Event})
-        self.replay_cache: Dict[str, Tuple[int, Dict[str, Event]]] = {}
+        # journal path -> (watermark size, consumed frame-boundary
+        # offset, state) where state is an {event_id: Event} table for
+        # segments, {id: tomb_us} for tombstones.log, or {id: [buckets]}
+        # for external_ids.log (see _scan_journal)
+        self.replay_cache: Dict[str, Tuple[int, int, dict]] = {}
         self.index_cache: Dict[str, _SegmentIndex] = {}
         # observability + the sublinearity contract's test hook
         self.stats = {"segments_pruned": 0, "segments_scanned": 0}
@@ -165,10 +232,29 @@ class PevlogStorageClient:
 
 
 def _persist_index(seg_path: Path, ix: _SegmentIndex) -> None:
-    ix.synced = seg_path.stat().st_size if seg_path.exists() else 0
+    # synced = the bytes the in-memory state is KNOWN to cover (append
+    # offsets, not stat(): a concurrent writer may have grown the file
+    # past what this index has seen)
+    ix.synced = ix.mem_size
     tmp = seg_path.with_suffix(".idx.tmp")
     tmp.write_text(json.dumps(ix.dump()))
     tmp.replace(seg_path.with_suffix(".idx"))
+
+
+# generated ids are <16-hex bucket>-<32-hex uuid4>; anything else is an
+# externally supplied id (evlog's 32-hex ids don't match: no dash)
+_GEN_ID = re.compile(r"^[0-9a-f]{16}-[0-9a-f]{32}$")
+
+
+def _now_us() -> int:
+    return _us(datetime.now(timezone.utc))
+
+
+# deletion time assigned to tombstone frames written before tombstones
+# carried times: far enough in the future to always cover the frame
+# (the old semantics), and recognizably out of the valid range so the
+# reinsert path can refuse instead of minting an absurd creation time
+_LEGACY_TOMB_US = 1 << 62
 
 
 class PevlogEvents(base.EventStore):
@@ -188,11 +274,9 @@ class PevlogEvents(base.EventStore):
 
     @staticmethod
     def _bucket_from_id(event_id: str) -> Optional[int]:
-        head, _, _ = event_id.partition("-")
-        try:
-            return int(head, 16)
-        except ValueError:
+        if not _GEN_ID.match(event_id):
             return None
+        return int(event_id[:16], 16)
 
     def _segments(self, part: Path) -> List[Path]:
         if not part.exists():
@@ -218,48 +302,119 @@ class PevlogEvents(base.EventStore):
             except (ValueError, KeyError):
                 ix = None
         if ix is None or ix.synced != size:
-            ix = _SegmentIndex()
-            for ev in self._replay_segment(seg).values():
+            table = self._replay_segment(seg)
+            ix = _SegmentIndex(bits=_bloom_bits_for(len(table)))
+            # coverage = the size snapshot the replay was keyed on (the
+            # replay may have read past it if a writer raced — the index
+            # then over-covers, which can only disable pruning, never
+            # cause a false prune)
+            snap = self.c.replay_cache[str(seg)][0]
+            for ev in table.values():
                 ix.add(ev)
+            ix.mem_size = snap
             _persist_index(seg, ix)
-        ix.mem_size = size
+        else:
+            ix.mem_size = ix.synced
         self.c.index_cache[key] = ix
         return ix
 
     # -- replay --------------------------------------------------------------
-    def _replay_segment(self, seg: Path) -> Dict[str, Event]:
-        size = seg.stat().st_size if seg.exists() else 0
-        cached = self.c.replay_cache.get(str(seg))
+    def _scan_journal(self, path: Path, apply_frame) -> dict:
+        """Incremental size-keyed journal decode. Cache entries are
+        (watermark_size, consumed_offset, state): growth past the
+        watermark decodes only the tail from `consumed` (append-only
+        journals), with copy-on-write state so lock-free concurrent
+        readers keep a consistent snapshot."""
+        size = path.stat().st_size if path.exists() else 0
+        key = str(path)
+        cached = self.c.replay_cache.get(key)
+        if cached is not None and cached[1] > size:
+            cached = None   # journal shrank (remove/rollback): rescan
         if cached is not None and cached[0] == size:
-            return cached[1]
-        table: Dict[str, Event] = {}
-        for payload in EventLog(str(seg)).payloads():
-            obj = json.loads(payload)
-            if "$tombstone" in obj:      # migrated evlog journals
-                table.pop(obj["$tombstone"], None)
-                continue
-            e = _decode_payload(obj)
-            table[e.event_id] = e
-        self.c.replay_cache[str(seg)] = (size, table)
-        return table
+            return cached[2]
+        if cached is not None:
+            consumed, state = cached[1], dict(cached[2])
+        else:
+            consumed, state = 0, {}
+        for payload, end in EventLog(key).scan_from(consumed):
+            apply_frame(state, json.loads(payload))
+            consumed = end
+        self.c.replay_cache[key] = (size, consumed, state)
+        return state
 
-    def _tombstones(self, part: Path) -> Set[str]:
-        path = part / "tombstones.log"
-        if not path.exists():
-            return set()
-        size = path.stat().st_size
-        cached = self.c.replay_cache.get(str(path))
-        if cached is not None and cached[0] == size:
-            return cached[1]
-        dead = {json.loads(p)["$tombstone"]
-                for p in EventLog(str(path)).payloads()}
-        self.c.replay_cache[str(path)] = (size, dead)
-        return dead
+    @staticmethod
+    def _apply_event_frame(table: dict, obj: dict) -> None:
+        if "$tombstone" in obj:          # migrated evlog journals
+            table.pop(obj["$tombstone"], None)
+            return
+        e = _decode_payload(obj)
+        table[e.event_id] = e
+
+    def _replay_segment(self, seg: Path) -> Dict[str, Event]:
+        return self._scan_journal(seg, self._apply_event_frame)
+
+    @staticmethod
+    def _apply_tombstone_frame(dead: dict, obj: dict) -> None:
+        tus = obj.get("tus", _LEGACY_TOMB_US)
+        key = obj["$tombstone"]
+        dead[key] = max(dead.get(key, -1), tus)
+
+    def _tombstones(self, part: Path) -> Dict[str, int]:
+        """id -> latest deletion time (us). A frame is dead iff its
+        creation time <= that. Legacy untimed tombstones read as
+        +inf-ish (always dead, no resurrect)."""
+        return self._scan_journal(part / "tombstones.log",
+                                  self._apply_tombstone_frame)
+
+    @staticmethod
+    def _live(e: Event, dead: Dict[str, int]) -> bool:
+        return dead.get(e.event_id, -1) < _us(e.creation_time)
+
+    @staticmethod
+    def _apply_ext_frame(ext: dict, obj: dict) -> None:
+        # copy-on-write for the inner lists too: concurrent readers may
+        # hold the previous snapshot's list objects
+        buckets = list(ext.get(obj["x"], ()))
+        if obj["b"] not in buckets:
+            buckets.append(obj["b"])
+        ext[obj["x"]] = buckets
+
+    def _ext_index(self, part: Path) -> Dict[str, List[int]]:
+        """id -> buckets an externally supplied id was appended to."""
+        return self._scan_journal(part / "external_ids.log",
+                                  self._apply_ext_frame)
 
     # -- contract ------------------------------------------------------------
+    def _ensure_ext_log(self, part: Path) -> None:
+        """The ext log's existence marks a partition whose external ids
+        are all recorded (get()'s generated-shape fast-path miss is then
+        authoritative). Upgrading a legacy partition must BACKFILL
+        entries for every frame living outside its id's prefix bucket
+        before the marker appears — atomically (tmp + rename), so a
+        crash mid-backfill doesn't leave a marker that hides data."""
+        path = part / "external_ids.log"
+        if path.exists():
+            return
+        frames = []
+        for seg in self._segments(part):
+            seg_bucket = int(seg.name[4:20], 16)
+            for eid in self._replay_segment(seg):
+                if self._bucket_from_id(eid) != seg_bucket:
+                    frames.append(json.dumps(
+                        {"x": eid, "b": seg_bucket}).encode())
+        tmp = part / "external_ids.log.tmp"
+        if tmp.exists():
+            tmp.unlink()
+        if frames:
+            EventLog(str(tmp)).append_many(frames)
+        else:
+            tmp.touch()
+        tmp.replace(path)
+
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        self._part_dir(app_id, channel_id).mkdir(parents=True,
-                                                 exist_ok=True)
+        part = self._part_dir(app_id, channel_id)
+        part.mkdir(parents=True, exist_ok=True)
+        self._ensure_ext_log(part)
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -288,23 +443,49 @@ class PevlogEvents(base.EventStore):
         update per touched segment."""
         part = self._part_dir(app_id, channel_id)
         part.mkdir(parents=True, exist_ok=True)
+        self._ensure_ext_log(part)
         out_ids: List[str] = []
         by_seg: Dict[int, List[Event]] = {}
         batch_ids: Set[str] = set()
+        ext_frames: List[bytes] = []
         with self.c.lock:
+            dead = self._tombstones(part)
+            ext = self._ext_index(part)
             for event in events:
                 if event.event_id:
                     # only externally supplied ids can collide; generated
                     # ids are uuid4 (checking them would force a replay
-                    # of the segment per batch — O(N^2) ingest)
+                    # of the segment per batch — O(N^2) ingest). The ext
+                    # index pins down every segment an external id ever
+                    # landed in, so cross-bucket dups are caught too.
                     e = event
                     bucket = self._bucket_of(e)
-                    seg = self._segment_path(part, bucket)
-                    if (e.event_id in batch_ids
-                            or e.event_id in self._replay_segment(seg)):
+                    if e.event_id in batch_ids:
                         raise base.StorageWriteError(
                             f"Duplicate event id {e.event_id}")
+                    for b in {bucket, *ext.get(e.event_id, ())}:
+                        seg = self._segment_path(part, b)
+                        prev = self._replay_segment(seg).get(e.event_id)
+                        if prev is not None and self._live(prev, dead):
+                            raise base.StorageWriteError(
+                                f"Duplicate event id {e.event_id}")
+                    # delete-then-reinsert: if a tombstone would also
+                    # cover the NEW frame (clock tie or skew), nudge its
+                    # creation time past the tombstone so it is live
+                    tomb = dead.get(e.event_id, -1)
+                    if tomb >= _LEGACY_TOMB_US:
+                        # an untimed (pre-upgrade) tombstone covers ALL
+                        # frames of this id forever; a reinsert would be
+                        # silently invisible — refuse instead
+                        raise base.StorageWriteError(
+                            f"Event id {e.event_id} was deleted by a "
+                            "legacy untimed tombstone and cannot be "
+                            "reinserted")
+                    if tomb >= _us(e.creation_time):
+                        e = replace(e, creation_time=_from_us(tomb + 1))
                     batch_ids.add(e.event_id)
+                    ext_frames.append(json.dumps(
+                        {"x": e.event_id, "b": bucket}).encode())
                 else:
                     e = event.with_id(self._new_id(event))
                     # routing is ALWAYS by event time; an id prefix does
@@ -312,14 +493,34 @@ class PevlogEvents(base.EventStore):
                     bucket = self._bucket_of(e)
                 by_seg.setdefault(bucket, []).append(e)
                 out_ids.append(e.event_id)
+            # ext records BEFORE the segment appends: a crash in between
+            # leaves a harmless unreferenced ext entry, whereas the
+            # reverse order would strand a generated-shape external id
+            # beyond the reach of get()/delete() (whose targeted miss is
+            # authoritative) and of cross-bucket duplicate detection
+            if ext_frames:
+                EventLog(str(part / "external_ids.log")).append_many(
+                    ext_frames)
             for bucket, evs in by_seg.items():
                 seg = self._segment_path(part, bucket)
                 ix = self._index(seg)
-                EventLog(str(seg)).append_many(
-                    [_compact_payload(e) for e in evs])
-                for e in evs:
-                    ix.add(e)
-                ix.mem_size = seg.stat().st_size
+                blobs = [_compact_payload(e) for e in evs]
+                off, end = EventLog(str(seg)).append_many(blobs)
+                if off != ix.mem_size or end - off != framed_size(blobs):
+                    # another process appended between our index snapshot
+                    # and this append (or interleaved with the legacy
+                    # looped fallback): the journal is the source of
+                    # truth — rebuild (covers our frames too)
+                    self.c.index_cache.pop(str(seg), None)
+                    ix = self._index(seg)
+                else:
+                    for e in evs:
+                        ix.add(e)
+                    ix.mem_size = end
+                    if ix.bloom_saturated:
+                        ix = ix.with_grown_bloom(
+                            self._replay_segment(seg).values())
+                        self.c.index_cache[str(seg)] = ix
                 ix.dirty += len(evs)
                 if ix.dirty >= _IDX_FLUSH_EVERY:
                     _persist_index(seg, ix)
@@ -332,31 +533,43 @@ class PevlogEvents(base.EventStore):
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         part = self._part_dir(app_id, channel_id)
-        if event_id in self._tombstones(part):
-            return None
+        dead = self._tombstones(part)
         bucket = self._bucket_from_id(event_id)
-        if bucket is not None:
-            seg = self._segment_path(part, bucket)
-            ev = self._replay_segment(seg).get(event_id)
-            if ev is not None:
+        targets: List[int] = [] if bucket is None else [bucket]
+        for b in self._ext_index(part).get(event_id, ()):
+            if b not in targets:
+                targets.append(b)
+        for b in targets:
+            ev = self._replay_segment(
+                self._segment_path(part, b)).get(event_id)
+            if ev is not None and self._live(ev, dead):
                 return ev
-            # an EXTERNAL id can coincidentally parse as a bucket prefix
-            # (e.g. a standard UUID's hex head); fall through to the
-            # full scan rather than trusting the fast path's miss
+        if bucket is not None and (part / "external_ids.log").exists():
+            # generated-shape ids are either store-generated (live in
+            # their prefix segment) or imported (recorded in the ext
+            # index) — the targeted miss is authoritative, no full scan.
+            # A partition WITHOUT an ext log predates external-id
+            # recording: fall through to the scan
+            return None
         for seg in self._segments(part):
             ev = self._replay_segment(seg).get(event_id)
-            if ev is not None:
+            if ev is not None and self._live(ev, dead):
                 return ev
         return None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         with self.c.lock:
-            if self.get(event_id, app_id, channel_id) is None:
+            ev = self.get(event_id, app_id, channel_id)
+            if ev is None:
                 return False
             part = self._part_dir(app_id, channel_id)
+            # clamp to the frame's creation time so events stamped in
+            # the future (imports) are still covered by the tombstone
+            tus = max(_now_us(), _us(ev.creation_time))
             EventLog(str(part / "tombstones.log")).append(
-                json.dumps({"$tombstone": event_id}).encode())
+                json.dumps({"$tombstone": event_id,
+                            "tus": tus}).encode())
         return True
 
     def find(self, app_id: int, channel_id: Optional[int] = None, *,
@@ -382,7 +595,7 @@ class PevlogEvents(base.EventStore):
                 continue
             self.c.stats["segments_scanned"] += 1
             for e in self._replay_segment(seg).values():
-                if e.event_id in dead:
+                if not self._live(e, dead):
                     continue
                 if base.match_event(
                         e, start_time=start_time, until_time=until_time,

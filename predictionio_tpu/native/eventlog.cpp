@@ -76,6 +76,13 @@ long long locked_append(const char* path, const uint8_t* head,
   bool ok = (head_len == 0 || write_all(fd, head, head_len)) &&
             (body_len == 0 || write_all(fd, body, body_len));
   if (ok && fsync(fd) != 0) ok = false;
+  if (!ok) {
+    // a partial write (ENOSPC, signal) would leave a torn frame
+    // mid-file; every later O_APPEND frame would land AFTER it and be
+    // invisible to readers (scans stop at the first bad frame). Roll
+    // the file back to the pre-append boundary while the lock is held.
+    if (ftruncate(fd, offset) == 0) fsync(fd);
+  }
   flock(fd, LOCK_UN);
   close(fd);
   return ok ? (long long)offset : -1;

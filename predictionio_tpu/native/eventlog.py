@@ -19,6 +19,13 @@ MAGIC = 0x50494F45
 _HEADER = struct.Struct("<III")
 
 
+def framed_size(payloads: List[bytes]) -> int:
+    """Journal bytes the framed payloads occupy (header + body per
+    frame) — lets callers compute the exact end offset of an
+    `append_many` blob from its returned start offset."""
+    return sum(_HEADER.size + len(p) for p in payloads)
+
+
 class EventLog:
     """Append/scan one journal file."""
 
@@ -59,39 +66,70 @@ class EventLog:
             return int(off)
         return self._py_append(payload)
 
-    def append_many(self, payloads: List[bytes]) -> int:
+    def append_many(self, payloads: List[bytes]) -> Tuple[int, int]:
         """Bulk append: frames are built host-side and written as ONE
         blob under a single lock/fsync (the 10M-event ingest path costs
         one syscall set per batch instead of per event). Returns the
-        blob's file offset."""
+        blob's (start, end) byte range; end - start > framed_size(
+        payloads) signals a concurrent writer interleaved (only possible
+        on the looped legacy fallback)."""
         if not payloads:
-            return Path(self.path).stat().st_size if \
+            size = Path(self.path).stat().st_size if \
                 Path(self.path).exists() else 0
+            return size, size
         blob = b"".join(
             _HEADER.pack(MAGIC, len(p), zlib.crc32(p) & 0xFFFFFFFF) + p
             for p in payloads)
-        if self._lib is not None and self._has_blob:
-            off = self._lib.el_append_blob(self.path.encode(), blob,
-                                           len(blob))
-            if off < 0:
-                raise IOError(f"el_append_blob failed for {self.path}")
-            return int(off)
-        with open(self.path, "ab") as f:
-            off = f.tell()
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        return off
+        if self._lib is not None:
+            if self._has_blob:
+                off = self._lib.el_append_blob(self.path.encode(), blob,
+                                               len(blob))
+                if off < 0:
+                    raise IOError(f"el_append_blob failed for {self.path}")
+                return int(off), int(off) + len(blob)
+            # lib predates el_append_blob: loop the flock'd per-frame
+            # append rather than raw Python writes, which would bypass
+            # the journal's multi-process locking and can tear frames
+            # under a concurrent native writer
+            first = None
+            for p in payloads:
+                off = self.append(p)
+                if first is None:
+                    first = off
+            return int(first), int(off) + framed_size([payloads[-1]])
+        off = self._py_append_raw(blob)
+        return off, off + len(blob)
 
     def _py_append(self, payload: bytes) -> int:
         header = _HEADER.pack(MAGIC, len(payload),
                               zlib.crc32(payload) & 0xFFFFFFFF)
-        with open(self.path, "ab") as f:
+        return self._py_append_raw(header + payload)
+
+    def _py_append_raw(self, blob: bytes) -> int:
+        # unbuffered so a failed write can be rolled back to the frame
+        # boundary — a torn frame mid-file would hide every later append
+        # from readers (scans stop at the first bad frame)
+        with open(self.path, "ab", buffering=0) as f:
             off = f.tell()
-            f.write(header)
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
+            try:
+                # raw FileIO.write is one write(2): it can return short
+                # (e.g. the ~2 GiB per-syscall cap) without raising, so
+                # loop — a silently-truncated frame would hide every
+                # later append from readers
+                view = memoryview(blob)
+                written = 0
+                while written < len(blob):
+                    n = f.write(view[written:])
+                    if not n:
+                        raise OSError("short write")
+                    written += n
+                os.fsync(f.fileno())
+            except OSError:
+                try:
+                    os.truncate(self.path, off)
+                except OSError:
+                    pass
+                raise
         return off
 
     # -- scan ---------------------------------------------------------------
@@ -116,6 +154,32 @@ class EventLog:
                     yield f.read(lens[i])
             return
         yield from self._py_payloads()
+
+    def scan_from(self, start: int) -> Iterator[Tuple[bytes, int]]:
+        """(payload, end-offset-after-frame) pairs from byte `start` (a
+        frame boundary). The end offsets let incremental consumers
+        (pevlog's replay caches) resume decoding at the tail instead of
+        re-reading whole journals after every append — bulk imports of
+        externally-id'd events would otherwise go quadratic. Stops at
+        the first invalid/torn frame, like every other scan."""
+        if not Path(self.path).exists():
+            return
+        with open(self.path, "rb") as f:
+            f.seek(start)
+            pos = start
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    return
+                magic, length, crc = _HEADER.unpack(header)
+                if magic != MAGIC or length > (1 << 30):
+                    return
+                payload = f.read(length)
+                if len(payload) < length or \
+                        zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    return
+                pos += _HEADER.size + length
+                yield payload, pos
 
     def _py_payloads(self) -> Iterator[bytes]:
         with open(self.path, "rb") as f:
