@@ -1,5 +1,8 @@
+"""``python -m predictionio_tpu.cli`` entry point."""
+
 import sys
 
 from predictionio_tpu.cli.main import main
+
 
 sys.exit(main())

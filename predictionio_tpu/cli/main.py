@@ -107,6 +107,18 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--port", type=int, default=8000)
     x.add_argument("--accesskey", default="",
                    help="server key when /stop is key-protected")
+    x = sub.add_parser(
+        "redeploy",
+        help="train, then hot-reload the running prediction server "
+             "(the cron recipe from examples/redeploy-script/"
+             "redeploy.sh: put 'pio-tpu redeploy' in crontab)")
+    x.add_argument("--engine-json", default="engine.json")
+    x.add_argument("--engine-factory")
+    x.add_argument("--mesh")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--accesskey", default="",
+                   help="server key when /reload is key-protected")
     x = sub.add_parser("batchpredict")
     x.add_argument("--engine-json", default="engine.json")
     x.add_argument("--engine-factory")
@@ -247,6 +259,15 @@ def main(argv: Optional[list] = None) -> int:
             ok = ops.undeploy(args.ip, args.port,
                               access_key=args.accesskey)
             print("Undeployed" if ok else "No server responded")
+            return 0 if ok else 1
+        if cmd == "redeploy":
+            _emit(ops.train(
+                _registry(), engine_json=args.engine_json,
+                engine_factory=args.engine_factory, mesh=args.mesh))
+            ok = ops.reload_server(args.ip, args.port,
+                                   access_key=args.accesskey)
+            print("Reloaded" if ok
+                  else "Trained, but no server responded to /reload")
             return 0 if ok else 1
         if cmd == "batchpredict":
             _emit(ops.batchpredict(

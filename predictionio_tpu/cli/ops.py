@@ -7,7 +7,6 @@ Import}.scala`.
 from __future__ import annotations
 
 import json
-import sys
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -294,13 +293,13 @@ def _latest_completed(registry, variant_id: str):
     return inst
 
 
-def undeploy(ip: str = "127.0.0.1", port: int = 8000,
-             access_key: str = "") -> bool:
-    """POST /stop to a running prediction server (Console undeploy).
-    `access_key` is the server key when /stop is key-protected. The key
-    travels as the Basic-auth username (KeyAuthentication accepts it
-    there), not as a query param, so it never lands in proxy/access
-    logs."""
+def _post_server(ip: str, port: int, endpoint: str, access_key: str,
+                 timeout: float) -> bool:
+    """POST a lifecycle endpoint on a running prediction server. The
+    server key travels as the Basic-auth username (KeyAuthentication
+    accepts it there), not as a query param, so it never lands in
+    proxy/access logs. 401 raises (key needed); unreachable/refused
+    returns False."""
     import base64
     import urllib.error
     import urllib.request
@@ -309,19 +308,34 @@ def undeploy(ip: str = "127.0.0.1", port: int = 8000,
         headers["Authorization"] = "Basic " + base64.b64encode(
             f"{access_key}:".encode()).decode()
     try:
-        req = urllib.request.Request(f"http://{ip}:{port}/stop",
+        req = urllib.request.Request(f"http://{ip}:{port}{endpoint}",
                                      data=b"", method="POST",
                                      headers=headers)
-        with urllib.request.urlopen(req, timeout=5) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status == 200
     except urllib.error.HTTPError as e:
         if e.code == 401:
             raise ValueError(
-                "Unauthorized: the server's /stop is key-protected; pass "
-                "--accesskey with the server key") from e
+                f"Unauthorized: the server's {endpoint} is "
+                "key-protected; pass --accesskey with the server key"
+            ) from e
         return False
-    except Exception:
+    except OSError:
         return False
+
+
+def reload_server(ip: str = "127.0.0.1", port: int = 8000,
+                  access_key: str = "") -> bool:
+    """POST /reload: hot-swap to the latest COMPLETED instance. The
+    train-then-reload pair is the reference's cron redeploy recipe
+    (examples/redeploy-script/redeploy.sh)."""
+    return _post_server(ip, port, "/reload", access_key, timeout=30)
+
+
+def undeploy(ip: str = "127.0.0.1", port: int = 8000,
+             access_key: str = "") -> bool:
+    """POST /stop to a running prediction server (Console undeploy)."""
+    return _post_server(ip, port, "/stop", access_key, timeout=5)
 
 
 # ---------------------------------------------------------------------------

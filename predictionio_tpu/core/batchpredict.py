@@ -12,7 +12,7 @@ RDD; here queries are chunked into device batches through the algorithms'
 from __future__ import annotations
 
 import json
-from typing import Iterable, Iterator, List, Optional
+from typing import Iterable, Iterator, List
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.params import extract_params

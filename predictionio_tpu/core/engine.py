@@ -10,7 +10,7 @@ deploy-time model preparation split out into persistence.py.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Type
+from typing import Any, Dict, List, Mapping, Sequence, Tuple, Type
 
 from predictionio_tpu.core.base import (
     Algorithm, DataSource, Preparator, Serving,

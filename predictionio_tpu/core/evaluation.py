@@ -21,8 +21,8 @@ from __future__ import annotations
 import json
 import math
 import traceback
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.core.base import Evaluator
 from predictionio_tpu.core.engine import Engine

@@ -12,7 +12,7 @@ and replaces the store's contents accordingly (`cleanPersistedPEvents`).
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, replace
+from dataclasses import dataclass
 from datetime import timedelta
 from typing import Iterable, List, Optional, Tuple
 

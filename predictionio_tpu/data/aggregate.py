@@ -22,7 +22,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Mapping, Optional, Tuple
 
-from predictionio_tpu.data.event import DataMap, Event, PropertyMap, from_millis, to_millis
+from predictionio_tpu.data.event import (
+    DataMap, Event, PropertyMap, from_millis, to_millis,
+)
 
 
 @dataclass(frozen=True)

@@ -22,7 +22,6 @@ channel by name within the key's app.
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass
 from typing import Optional, Sequence
 from urllib.parse import parse_qs

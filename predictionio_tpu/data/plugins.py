@@ -12,7 +12,7 @@ from __future__ import annotations
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
 
@@ -34,7 +34,8 @@ class EventServerPlugin:
     plugin_description: str = ""
     plugin_type: str = INPUT_SNIFFER
 
-    def process(self, event_info: EventInfo, context: "EventServerPluginContext") -> None:
+    def process(self, event_info: EventInfo,
+                context: "EventServerPluginContext") -> None:
         """Blockers: raise to veto. Sniffers: observe."""
 
     def handle_rest(self, app_id: int, channel_id: Optional[int],

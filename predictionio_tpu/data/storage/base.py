@@ -22,7 +22,7 @@ import secrets
 import re
 from dataclasses import dataclass, field, replace
 from datetime import datetime
-from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence
 
 from predictionio_tpu.data.aggregate import aggregate_properties
 from predictionio_tpu.data.event import Event, PropertyMap, utcnow

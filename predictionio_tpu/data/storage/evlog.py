@@ -16,7 +16,7 @@ import json
 import threading
 from datetime import timezone
 from pathlib import Path
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, datetime
 from predictionio_tpu.data.storage import base

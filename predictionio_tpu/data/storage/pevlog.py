@@ -13,6 +13,11 @@ each segment carries a sidecar index with
   - a Bloom filter over (entityType, entityId)  -> entity finds skip
     segments that never saw the entity (the role of HBase's MD5-prefix
     rowkey locality)
+  - an exact event-name set + a (targetEntityType, targetEntityId)
+    Bloom -> event-name and target-entity finds prune too: the
+    field-query pushdown the reference fills with Elasticsearch's
+    query DSL (`storage/elasticsearch/.../ESLEvents.scala:308`), at
+    segment (skip-index) granularity
 
 Event ids encode their segment bucket (`<bucket_us_hex>-<uuid>`, the
 analog of HBase's rowkey-as-eventId, HBEventsUtil.scala:112-135), so
@@ -127,7 +132,12 @@ def _bloom_positions(entity_type: str, entity_id: str,
 
 
 class _SegmentIndex:
-    """min/max event time + entity Bloom for one segment journal."""
+    """Per-segment sidecar: min/max event time, entity Bloom, exact
+    event-name set, and target-entity Bloom. The field indexes give
+    `find` pushdown on event names and target entities — the role the
+    reference fills with Elasticsearch's query DSL
+    (`ESLEvents.scala:308`), at segment granularity (the skip-index
+    design, like HBase filter pushdown for the entity/time axes)."""
 
     def __init__(self, bits: int = _BLOOM_BITS):
         self.min_us = None
@@ -137,15 +147,24 @@ class _SegmentIndex:
         self.bits = bits
         self.filled = 0          # set bits (saturation tracking)
         self.bloom = bytearray(bits // 8)
+        # target-entity Bloom shares bits/growth with the entity Bloom
+        self.tbloom = bytearray(bits // 8)
+        self.tfilled = 0
+        self.event_names: Set[str] = set()   # exact: low cardinality
         self.dirty = 0           # appends since last persist
         self.mem_size = 0        # journal bytes the in-memory state covers
 
-    def _bloom_add(self, entity_type: str, entity_id: str) -> None:
-        for pos in _bloom_positions(entity_type, entity_id, self.bits):
+    def _bits_add(self, buf: bytearray, key_type: str, key_id: str) -> int:
+        new = 0
+        for pos in _bloom_positions(key_type, key_id, self.bits):
             byte, bit = pos // 8, 1 << (pos % 8)
-            if not self.bloom[byte] & bit:
-                self.bloom[byte] |= bit
-                self.filled += 1
+            if not buf[byte] & bit:
+                buf[byte] |= bit
+                new += 1
+        return new
+
+    def _bloom_add(self, entity_type: str, entity_id: str) -> None:
+        self.filled += self._bits_add(self.bloom, entity_type, entity_id)
 
     def add(self, ev: Event) -> None:
         t = _us(ev.event_time)
@@ -153,15 +172,32 @@ class _SegmentIndex:
         self.max_us = t if self.max_us is None else max(self.max_us, t)
         self.count += 1
         self._bloom_add(ev.entity_type, ev.entity_id)
+        self.event_names.add(ev.event)
+        if ev.target_entity_type and ev.target_entity_id:
+            self.tfilled += self._bits_add(
+                self.tbloom, ev.target_entity_type, ev.target_entity_id)
+
+    def _bits_contain(self, buf: bytearray, key_type: str,
+                      key_id: str) -> bool:
+        return all(buf[p // 8] & (1 << (p % 8))
+                   for p in _bloom_positions(key_type, key_id, self.bits))
 
     def may_contain(self, entity_type: str, entity_id: str) -> bool:
-        return all(self.bloom[p // 8] & (1 << (p % 8))
-                   for p in _bloom_positions(entity_type, entity_id,
-                                             self.bits))
+        return self._bits_contain(self.bloom, entity_type, entity_id)
+
+    def may_contain_target(self, tet: str, tei: str) -> bool:
+        return self._bits_contain(self.tbloom, tet, tei)
+
+    def may_contain_event(self, names) -> bool:
+        # empty set = a legacy sidecar that never recorded names: no
+        # pruning evidence, must scan
+        if not self.event_names:
+            return True
+        return any(n in self.event_names for n in names)
 
     @property
     def bloom_saturated(self) -> bool:
-        return self.filled * _BLOOM_MAX_FILL > self.bits
+        return max(self.filled, self.tfilled) * _BLOOM_MAX_FILL > self.bits
 
     def with_grown_bloom(self, events) -> "_SegmentIndex":
         """A NEW index with a filter resized for `events` (this object
@@ -175,8 +211,12 @@ class _SegmentIndex:
         ix.min_us, ix.max_us = self.min_us, self.max_us
         ix.count, ix.synced = self.count, self.synced
         ix.mem_size, ix.dirty = self.mem_size, self.dirty
+        ix.event_names = set(self.event_names)
         for ev in events:
             ix._bloom_add(ev.entity_type, ev.entity_id)
+            if ev.target_entity_type and ev.target_entity_id:
+                ix.tfilled += ix._bits_add(
+                    ix.tbloom, ev.target_entity_type, ev.target_entity_id)
         return ix
 
     def overlaps(self, start_us: Optional[int],
@@ -193,7 +233,9 @@ class _SegmentIndex:
         return {"min_us": self.min_us, "max_us": self.max_us,
                 "count": self.count, "synced": self.synced,
                 "bits": self.bits,
-                "bloom": b64encode(bytes(self.bloom)).decode()}
+                "bloom": b64encode(bytes(self.bloom)).decode(),
+                "tbloom": b64encode(bytes(self.tbloom)).decode(),
+                "events": sorted(self.event_names)}
 
     @classmethod
     def load(cls, obj: dict) -> "_SegmentIndex":
@@ -205,6 +247,13 @@ class _SegmentIndex:
         ix.bloom = bytearray(b64decode(obj["bloom"]))
         ix.bits = obj.get("bits", len(ix.bloom) * 8)
         ix.filled = int.from_bytes(bytes(ix.bloom), "little").bit_count()
+        if "tbloom" in obj:
+            ix.tbloom = bytearray(b64decode(obj["tbloom"]))
+        else:          # legacy sidecar: no pruning evidence, never prune
+            ix.tbloom = bytearray(b"\xff" * (ix.bits // 8))
+        ix.tfilled = int.from_bytes(bytes(ix.tbloom),
+                                    "little").bit_count()
+        ix.event_names = set(obj.get("events", ()))
         return ix
 
 
@@ -393,23 +442,28 @@ class PevlogEvents(base.EventStore):
         before the marker appears — atomically (tmp + rename), so a
         crash mid-backfill doesn't leave a marker that hides data."""
         path = part / "external_ids.log"
-        if path.exists():
-            return
-        frames = []
-        for seg in self._segments(part):
-            seg_bucket = int(seg.name[4:20], 16)
-            for eid in self._replay_segment(seg):
-                if self._bucket_from_id(eid) != seg_bucket:
-                    frames.append(json.dumps(
-                        {"x": eid, "b": seg_bucket}).encode())
-        tmp = part / "external_ids.log.tmp"
-        if tmp.exists():
-            tmp.unlink()
-        if frames:
-            EventLog(str(tmp)).append_many(frames)
-        else:
-            tmp.touch()
-        tmp.replace(path)
+        with self.c.lock:   # serialize vs concurrent inserts: a racing
+            # backfill's rename must never clobber frames another
+            # thread just appended to the freshly created log
+            if path.exists():
+                return
+            frames = []
+            for seg in self._segments(part):
+                seg_bucket = int(seg.name[4:20], 16)
+                for eid in self._replay_segment(seg):
+                    if self._bucket_from_id(eid) != seg_bucket:
+                        frames.append(json.dumps(
+                            {"x": eid, "b": seg_bucket}).encode())
+            tmp = part / "external_ids.log.tmp"
+            if tmp.exists():
+                tmp.unlink()
+            if frames:
+                EventLog(str(tmp)).append_many(frames)
+            else:
+                tmp.touch()
+            tmp.replace(path)
+            # the file identity changed: any cached scan state is stale
+            self.c.replay_cache.pop(str(path), None)
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         part = self._part_dir(app_id, channel_id)
@@ -591,6 +645,15 @@ class PevlogEvents(base.EventStore):
                 continue
             if entity_type is not None and entity_id is not None \
                     and not ix.may_contain(entity_type, entity_id):
+                self.c.stats["segments_pruned"] += 1
+                continue
+            if event_names and not ix.may_contain_event(event_names):
+                self.c.stats["segments_pruned"] += 1
+                continue
+            if isinstance(target_entity_type, str) \
+                    and isinstance(target_entity_id, str) \
+                    and not ix.may_contain_target(target_entity_type,
+                                                  target_entity_id):
                 self.c.stats["segments_pruned"] += 1
                 continue
             self.c.stats["segments_scanned"] += 1

@@ -1,0 +1,154 @@
+"""Batch views: parquet-cached materializations of an app's events.
+
+The reference's view subsystem
+(`data/src/main/scala/org/apache/predictionio/data/view/DataView.scala:
+43-100`) materializes an app's events into a parquet-backed DataFrame,
+keyed by (appId, channelId, startTime, untilTime) with a staleness
+TTL — repeated `DataView.create` calls inside that window reuse the
+cached parquet instead of rescanning the event store. `LBatchView` /
+`PBatchView` (deprecated there) expose the same data as aggregated
+property maps + event batches.
+
+TPU-native analog: training reads go through `ingest/arrays.py` dense
+columns, so the view's job here is exactly the reference's — an
+offline, re-readable, columnar snapshot for exploratory/batch work that
+does not want to replay the event store every time. Cache files are
+parquet in the `export_events` schema (portable: `pio-tpu import` reads
+them back), named by a key hash, written atomically, and reused while
+younger than `ttl_seconds`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, Iterator, Optional
+
+from predictionio_tpu.data import store
+from predictionio_tpu.data.event import Event, PropertyMap
+
+
+class DataView:
+    """Parquet-cached event view of one app/channel (DataView.scala:43).
+
+    ``events()`` returns a pyarrow Table (the DataFrame analog);
+    ``event_batch()`` iterates `Event` objects from the cached snapshot
+    (the LBatchView role); ``aggregate_properties()`` is the PBatchView
+    role, served live from the store's aggregation monoid (it is already
+    a single indexed pass, with nothing to cache)."""
+
+    def __init__(self, registry, app_name: str,
+                 channel: Optional[str] = None,
+                 cache_dir: str = ".pio_store/views"):
+        self.registry = registry
+        self.app_name = app_name
+        self.channel = channel
+        self.cache_dir = Path(cache_dir)
+
+    # -- cache keys ----------------------------------------------------------
+    def _cache_path(self, start_time, until_time) -> Path:
+        key = json.dumps([self.app_name, self.channel,
+                          str(start_time), str(until_time)])
+        digest = hashlib.sha1(key.encode()).hexdigest()[:16]
+        return self.cache_dir / f"view_{digest}.parquet"
+
+    def _materialize(self, path: Path, start_time, until_time) -> None:
+        app_id, channel_id = store.app_name_to_id(
+            self.registry, self.app_name, self.channel)
+        self.cache_dir.mkdir(parents=True, exist_ok=True)
+        # unique tmp per writer: concurrent materializations must not
+        # interleave into one file (last complete replace wins)
+        tmp = path.with_suffix(f".{os.getpid()}.{time.monotonic_ns()}.tmp")
+        # export_events writes the full store; narrow by time range via
+        # the store's find pushdown
+        events = self.registry.get_events().find(
+            app_id, channel_id, start_time=start_time,
+            until_time=until_time)
+        _write_parquet(events, str(tmp))
+        os.replace(tmp, path)
+
+    # -- the DataView.create contract ---------------------------------------
+    def events(self, start_time=None, until_time=None, *,
+               ttl_seconds: float = 3600.0, refresh: bool = False):
+        """pyarrow Table of the app's events in the window, cached as
+        parquet and reused while younger than `ttl_seconds`
+        (DataView.scala's staleness timeout)."""
+        import pyarrow.parquet as pq
+
+        path = self._cache_path(start_time, until_time)
+        stale = (refresh or not path.exists()
+                 or time.time() - path.stat().st_mtime > ttl_seconds)
+        if stale:
+            self._materialize(path, start_time, until_time)
+        return pq.read_table(path)
+
+    def event_batch(self, start_time=None, until_time=None, *,
+                    ttl_seconds: float = 3600.0) -> Iterator[Event]:
+        """Iterate `Event` objects from the cached snapshot (LBatchView
+        role)."""
+        table = self.events(start_time, until_time,
+                            ttl_seconds=ttl_seconds)
+        for row in table.to_pylist():
+            payload = {k: v for k, v in row.items() if v is not None}
+            if "properties" in payload:
+                payload["properties"] = json.loads(payload["properties"])
+            yield Event.from_api_json(payload)
+
+    def aggregate_properties(
+            self, entity_type: str) -> Dict[str, PropertyMap]:
+        """Latest property map per entity (PBatchView
+        aggregateProperties role) — served live from the store's
+        aggregation monoid."""
+        return store.aggregate_properties(
+            self.registry, self.app_name, channel_name=self.channel,
+            entity_type=entity_type)
+
+
+def _write_parquet(events, output_path: str) -> int:
+    """Write events to parquet in the `export_events` schema (the two
+    stay import-compatible; cli/ops.py:476-510 is the other writer)."""
+    import pyarrow as pa
+    import pyarrow.parquet
+
+    cols = ["eventId", "event", "entityType", "entityId",
+            "targetEntityType", "targetEntityId", "properties",
+            "eventTime", "tags", "prId", "creationTime"]
+    schema = pa.schema(
+        [(c, pa.list_(pa.string()) if c == "tags" else pa.string())
+         for c in cols])
+    writer = None
+    n = 0
+    chunk = []
+    try:
+        for e in events:
+            d = e.to_api_json()
+            if "properties" in d:
+                d["properties"] = json.dumps(d["properties"])
+            chunk.append(d)
+            if len(chunk) >= 1000:
+                writer = _flush_chunk(chunk, cols, schema, writer,
+                                      output_path)
+                n += len(chunk)
+                chunk = []
+        writer = _flush_chunk(chunk, cols, schema, writer, output_path)
+        n += len(chunk)
+    finally:
+        if writer is not None:
+            writer.close()
+    return n
+
+
+def _flush_chunk(chunk, cols, schema, writer, output_path):
+    import pyarrow as pa
+
+    if not chunk and writer is not None:
+        return writer
+    data = {c: [r.get(c) for r in chunk] for c in cols}
+    table = pa.table(data, schema=schema)
+    if writer is None:
+        writer = pa.parquet.ParquetWriter(output_path, schema)
+    writer.write_table(table)
+    return writer

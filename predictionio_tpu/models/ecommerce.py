@@ -20,7 +20,7 @@ adjust-score/src/main/scala/ECommAlgorithm.scala`
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

@@ -17,7 +17,7 @@ in one jit'd matmul+top_k, where the reference loops driver-side.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np

@@ -106,30 +106,39 @@ class EventLog:
         return self._py_append_raw(header + payload)
 
     def _py_append_raw(self, blob: bytes) -> int:
-        # unbuffered so a failed write can be rolled back to the frame
-        # boundary — a torn frame mid-file would hide every later append
-        # from readers (scans stop at the first bad frame)
+        # mirrors the C path's locked_append: flock so concurrent
+        # writers (native or Python) serialize, unbuffered so a failed
+        # write can be rolled back to the frame boundary — a torn frame
+        # mid-file would hide every later append from readers (scans
+        # stop at the first bad frame). Holding the lock is what makes
+        # the rollback truncate safe: no one else can have appended
+        # past `off` in the meantime.
+        import fcntl
+
         with open(self.path, "ab", buffering=0) as f:
-            off = f.tell()
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
             try:
-                # raw FileIO.write is one write(2): it can return short
-                # (e.g. the ~2 GiB per-syscall cap) without raising, so
-                # loop — a silently-truncated frame would hide every
-                # later append from readers
-                view = memoryview(blob)
-                written = 0
-                while written < len(blob):
-                    n = f.write(view[written:])
-                    if not n:
-                        raise OSError("short write")
-                    written += n
-                os.fsync(f.fileno())
-            except OSError:
+                off = os.lseek(f.fileno(), 0, os.SEEK_END)
                 try:
-                    os.truncate(self.path, off)
+                    # raw FileIO.write is one write(2): it can return
+                    # short (e.g. the ~2 GiB per-syscall cap) without
+                    # raising, so loop
+                    view = memoryview(blob)
+                    written = 0
+                    while written < len(blob):
+                        n = f.write(view[written:])
+                        if not n:
+                            raise OSError("short write")
+                        written += n
+                    os.fsync(f.fileno())
                 except OSError:
-                    pass
-                raise
+                    try:
+                        os.truncate(self.path, off)
+                    except OSError:
+                        pass
+                    raise
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
         return off
 
     # -- scan ---------------------------------------------------------------

@@ -22,7 +22,7 @@ the transfer amortizes. Inside a jit trace the device path is always used
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -15,8 +15,6 @@ as every other recommender.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

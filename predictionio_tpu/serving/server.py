@@ -234,22 +234,42 @@ class PredictionServer(HTTPServerBase):
         with self._dep_lock:
             self._dep = _Deployment(engine, instance, algos, models, serving)
 
+    @staticmethod
+    def _probe_occupant(host: str, port: int):
+        """GET /status.json from whatever occupies the port. Returns the
+        parsed status dict if it identifies as one of this framework's
+        prediction servers, else None."""
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/status.json", timeout=2) as r:
+                obj = json.loads(r.read())
+            return obj if "engineInstanceId" in obj else None
+        except Exception:
+            return None
+
     def start(self, background: bool = True) -> int:
         """Deploy first undeploys any server squatting on the target port
         (CreateServer.scala:347-357: the MasterActor sends StopServer to
-        the existing actor before binding); the base class then binds
-        with 3 retries to cover the port-release lag."""
+        the existing actor before binding) — but only after PROBING that
+        the occupant is one of this framework's prediction servers
+        deployed for the SAME engine variant. A foreign service, or a
+        different deployment, is never sent an unsolicited /stop; the
+        base class's bind retry surfaces EADDRINUSE instead so the
+        operator decides."""
         if self.port:
             from predictionio_tpu.cli.ops import undeploy
             host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
-            try:
-                undeploy(host, self.port,
-                         access_key=self.config.server_key)
-            except Exception:
-                # a key-protected squatter with a different key (or a
-                # non-pio process): the bind retry below will surface
-                # EADDRINUSE if it doesn't go away
-                pass
+            occ = self._probe_occupant(host, self.port)
+            if occ is not None and occ.get("engineVariant") == \
+                    self.config.engine_variant:
+                try:
+                    undeploy(host, self.port,
+                             access_key=self.config.server_key)
+                except Exception:
+                    # key-protected with a different key: let the bind
+                    # retry surface EADDRINUSE
+                    pass
         return super().start(background)
 
     # -- serving -------------------------------------------------------------

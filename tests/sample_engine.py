@@ -10,8 +10,8 @@ pd) — each stage wraps its input, so the final model records the exact
 path taken.
 """
 
-from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence
 
 from predictionio_tpu.core import (
     Algorithm, DataSource, Params, PersistentModel, Preparator, Serving,

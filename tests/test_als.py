@@ -9,7 +9,6 @@ the single-device path.
 
 import jax
 import numpy as np
-import pytest
 
 from predictionio_tpu.ops import als, oracle
 from predictionio_tpu.ops.topk import build_mask, topk_scores, topk_similar

@@ -11,8 +11,8 @@ import dataclasses
 import pytest
 
 from predictionio_tpu.core import (
-    CoreWorkflow, EmptyParams, Engine, EngineParams, FirstServing,
-    IdentityPreparator, Params, RuntimeContext, SimpleEngine, WorkflowParams,
+    CoreWorkflow, Engine, EngineParams, Params, RuntimeContext,
+    SimpleEngine, WorkflowParams,
     StopAfterPrepareInterruption, StopAfterReadInterruption,
     extract_params, register_engine, resolve_engine,
 )

@@ -21,7 +21,7 @@ from predictionio_tpu.data.storage.base import EvaluationInstanceStatus
 from predictionio_tpu.models import recommendation as rec
 
 import sample_engine as se
-from test_core_engine import make_engine, ep
+from test_core_engine import ep
 
 
 DATA = [(None, [(1, 2, 3), (2, 4, 6), (3, 6, 9)])]

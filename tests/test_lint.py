@@ -1,0 +1,40 @@
+"""The static-analysis gate, run in the suite the way the reference CI
+runs scalastyle + Apache RAT on every build (`tests/unit.sh:31-35`)."""
+
+from pathlib import Path
+
+from predictionio_tpu.tools import lint
+
+
+def test_lint_gate_clean():
+    violations = lint.run(Path(__file__).resolve().parents[1])
+    assert not violations, "\n".join(violations)
+
+
+def test_lint_catches_violations(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import os\n"                       # unused import, no docstring
+        "def f(x=[]):\n"                    # mutable default
+        "    try:\n        pass\n"
+        "    except:\n        pass\n"       # bare except
+    )
+    out = lint.run(tmp_path)
+    kinds = "\n".join(out)
+    assert "missing module docstring" in kinds
+    assert "unused import" in kinds
+    assert "mutable default" in kinds
+    assert "bare 'except:'" in kinds
+
+
+def test_string_annotations_count_as_usage(tmp_path):
+    f = tmp_path / "predictionio_tpu" / "ok.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        '"""doc"""\n'
+        "from typing import Mapping\n"
+        "def g(x: \"Mapping[str, int]\") -> None:\n"
+        "    return None\n"
+    )
+    assert not lint.run(tmp_path)

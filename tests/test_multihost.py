@@ -7,7 +7,6 @@ boundary to a multi-executor cluster (`Runner.Scala:213-215,298-305`).
 The sharded ALS factors must agree with single-process training.
 """
 
-import json
 import os
 import socket
 import subprocess

@@ -50,6 +50,54 @@ class TestPruning:
         assert store.c.stats["segments_scanned"] <= 2  # bloom fp slack
         assert store.c.stats["segments_pruned"] >= 23
 
+    def test_event_name_prunes_segments(self, store):
+        # "buy" events exist on one day only: an event-name find scans
+        # ~1 segment (the ES query-DSL pushdown role)
+        evs = [_mk(d, f"u{d}") for d in range(20)]
+        evs.append(_mk(7, "buyer", name="buy"))
+        store.insert_batch(evs, 1)
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        out = list(store.find(1, event_names=["buy"]))
+        assert [e.entity_id for e in out] == ["buyer"]
+        assert store.c.stats["segments_scanned"] == 1
+        assert store.c.stats["segments_pruned"] == 19
+
+    def test_target_entity_prunes_segments(self, store):
+        from predictionio_tpu.data import DataMap, Event
+        evs = [_mk(d, f"u{d}") for d in range(20)]
+        evs.append(Event(
+            event="view", entity_type="user", entity_id="u5",
+            target_entity_type="item", target_entity_id="rare-item",
+            properties=DataMap({}),
+            event_time=T0 + timedelta(days=13)))
+        store.insert_batch(evs, 1)
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        out = list(store.find(1, target_entity_type="item",
+                              target_entity_id="rare-item"))
+        assert len(out) == 1
+        assert store.c.stats["segments_scanned"] <= 2  # bloom fp slack
+        assert store.c.stats["segments_pruned"] >= 18
+
+    def test_legacy_sidecar_without_field_indexes_never_prunes(
+            self, store, tmp_path):
+        # a sidecar written before the field indexes existed: absent
+        # evidence must mean "scan", not "prune"
+        import json as _json
+        store.insert_batch([_mk(0, "u0", name="buy")], 1)
+        store.close()
+        [idx] = tmp_path.glob("app_1/seg_*.idx")
+        obj = _json.loads(idx.read_text())
+        del obj["events"], obj["tbloom"]
+        idx.write_text(_json.dumps(obj))
+        ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        assert [e.event for e in ev2.find(1, event_names=["buy"])] \
+            == ["buy"]
+        out = list(ev2.find(1, target_entity_type="t",
+                            target_entity_id="x"))
+        assert out == []    # matches nothing, but was scanned not pruned
+        assert ev2.c.stats["segments_scanned"] >= 2
+
     def test_full_scan_still_correct(self, store):
         store.insert_batch(
             [_mk(d, f"u{d % 3}") for d in range(10)], 1)

@@ -275,6 +275,72 @@ class TestServerKeyAuth:
             srv.shutdown()
 
 
+class TestRedeployRecipe:
+    def test_reload_server_hits_running_server(self, trained):
+        """`pio-tpu redeploy` = train + ops.reload_server — the analog
+        of examples/redeploy-script/redeploy.sh's curl to /reload."""
+        from predictionio_tpu.cli.ops import reload_server
+
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            assert reload_server("127.0.0.1", srv.port) is True
+        finally:
+            srv.shutdown()
+
+    def test_reload_server_no_server(self):
+        import socket
+
+        from predictionio_tpu.cli.ops import reload_server
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        assert reload_server("127.0.0.1", port) is False
+
+
+class TestForeignOccupantNotStopped:
+    def test_foreign_service_gets_no_stop_and_bind_fails(self, trained):
+        """The auto-undeploy PROBES the occupant first: a non-pio HTTP
+        service must never receive an unsolicited POST /stop; the deploy
+        fails with EADDRINUSE instead (advisor finding, round 3)."""
+        import http.server
+        import threading as _threading
+
+        registry, engine, _, _ = trained
+        hits = []
+
+        class Foreign(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append(("GET", self.path))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"hi")
+
+            def do_POST(self):
+                hits.append(("POST", self.path))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Foreign)
+        port = httpd.server_address[1]
+        t = _threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=port),
+                               registry=registry, engine=engine)
+        try:
+            with pytest.raises(OSError):
+                srv.start()
+            assert ("POST", "/stop") not in hits
+            assert ("GET", "/status.json") in hits   # probed, not stopped
+        finally:
+            httpd.shutdown()
+
+
 class TestDeployTwiceOnOnePort:
     def test_second_deploy_undeploys_squatter(self, trained):
         """Deploying on an occupied port first stops the squatting server

@@ -4,7 +4,9 @@ ALS, like/dislike ALS, cooccurrence, and score-averaging serving."""
 import numpy as np
 import pytest
 
-from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext, resolve_engine
+from predictionio_tpu.core import (
+    CoreWorkflow, EngineParams, RuntimeContext, resolve_engine,
+)
 from predictionio_tpu.data.event import DataMap, Event
 from predictionio_tpu.data.storage import App
 from predictionio_tpu.models import similarproduct as sp

@@ -4,7 +4,6 @@ import json
 import urllib.error
 import urllib.request
 
-import pytest
 
 from predictionio_tpu.core import (
     EngineParamsGenerator, Evaluation, RuntimeContext, run_evaluation,

@@ -1,0 +1,90 @@
+"""DataView batch views: parquet caching with TTL, event round-trip,
+and the PBatchView aggregation role (DataView.scala:43-100)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.view import DataView
+
+T0 = datetime(2023, 5, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture
+def app(mem_registry):
+    app_id = mem_registry.get_meta_data_apps().insert(App(0, "viewapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    events.insert_batch(
+        [Event(event="view", entity_type="user", entity_id=f"u{n % 3}",
+               target_entity_type="item", target_entity_id=f"i{n % 5}",
+               properties=DataMap({}),
+               event_time=T0 + timedelta(hours=n)) for n in range(20)]
+        + [Event(event="$set", entity_type="item", entity_id="i1",
+                 properties=DataMap({"price": 9.5}), event_time=T0)],
+        app_id)
+    return mem_registry
+
+
+class TestDataView:
+    def test_events_table_and_cache_reuse(self, app, tmp_path):
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        t = view.events()
+        assert t.num_rows == 21
+        cache_files = list(tmp_path.glob("view_*.parquet"))
+        assert len(cache_files) == 1
+        mtime = cache_files[0].stat().st_mtime
+        t2 = view.events()              # inside TTL: reuse, no rewrite
+        assert t2.num_rows == 21
+        assert cache_files[0].stat().st_mtime == mtime
+
+    def test_time_window_keys_separate_caches(self, app, tmp_path):
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        t = view.events(start_time=T0 + timedelta(hours=5),
+                        until_time=T0 + timedelta(hours=10))
+        assert t.num_rows == 5
+        assert len(list(tmp_path.glob("view_*.parquet"))) == 1
+        view.events()
+        assert len(list(tmp_path.glob("view_*.parquet"))) == 2
+
+    def test_refresh_and_ttl_expiry_rematerialize(self, app, tmp_path):
+        import os
+
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        view.events()
+        [f] = tmp_path.glob("view_*.parquet")
+        old = f.stat().st_mtime - 10_000
+        os.utime(f, (old, old))         # age the cache past any TTL
+        app.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="u9",
+                  properties=DataMap({}), event_time=T0), 1)
+        assert view.events(ttl_seconds=3600).num_rows == 22
+
+    def test_event_batch_round_trip(self, app, tmp_path):
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        evs = list(view.event_batch())
+        assert len(evs) == 21
+        assert all(isinstance(e, Event) for e in evs)
+        st = [e for e in evs if e.event == "$set"]
+        assert st[0].properties.get("price") == 9.5
+
+    def test_aggregate_properties_role(self, app, tmp_path):
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        props = view.aggregate_properties("item")
+        assert props["i1"].get("price") == 9.5
+
+    def test_cache_importable_by_cli(self, app, tmp_path):
+        # the view cache uses the export_events schema: `pio-tpu
+        # import --format parquet` must read it back
+        from predictionio_tpu.cli.ops import import_events
+
+        view = DataView(app, "viewapp", cache_dir=str(tmp_path))
+        view.events()
+        [f] = tmp_path.glob("view_*.parquet")
+        app2_id = app.get_meta_data_apps().insert(App(0, "viewapp2"))
+        n = import_events(app, app_id=app2_id, input_path=str(f),
+                          format="parquet")
+        assert n == 21
+        assert len(list(app.get_events().find(app2_id))) == 21
