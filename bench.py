@@ -176,12 +176,12 @@ def _cpu_per_iter_estimate(packed):
     rank = packed.rank
     rng = np.random.RandomState(0)
     y = rng.randn(max(packed.n_users, packed.n_items), rank).astype(np.float32)
-    total_entries = sum(ix.size for side in (packed.user_side,
-                                             packed.item_side)
-                        for ix in side.idx)
-    # sample: the largest slab, at most ~2M entries of it
-    slab = max((ix for side in (packed.user_side, packed.item_side)
-                for ix in side.idx), key=lambda a: a.size)
+    total_entries = _padded_entries(packed)
+    # sample: the largest slab chunk, at most ~2M entries of it
+    side, j = max(((s, jj) for s in (packed.user_side, packed.item_side)
+                   for jj in range(len(s.rows))),
+                  key=lambda sj: len(sj[0].rows[sj[1]]) * sj[0].caps[sj[1]])
+    slab = np.maximum(side.padded(j)[0], 0)   # [rows_b, cap] idx
     rows = max(1, min(len(slab), 2_000_000 // slab.shape[1]))
     yg = y[slab[:rows]]                       # [rows, cap, rank]
     t0 = time.perf_counter()
@@ -207,6 +207,14 @@ def _fenced_per_iter(f, lo=2, hi=10):
     return (t_hi - t_lo) / (hi - lo)
 
 
+def _padded_entries(packed):
+    """Total PADDED slab entries per iteration (rows x cap summed over
+    chunks, both sides) — the gather row count the roofline uses."""
+    return sum(len(rows) * cap
+               for side in (packed.user_side, packed.item_side)
+               for rows, cap in zip(side.rows, side.caps))
+
+
 def _ml25m_phase_breakdown(packed):
     """Measured per-iteration phase costs of the ML-25M step: the factor
     gather, gather+paired-Gram, and the full solve loop — the roofline
@@ -217,12 +225,10 @@ def _ml25m_phase_breakdown(packed):
 
     from predictionio_tpu.ops import als
 
-    slabs = []
-    for side in (packed.user_side, packed.item_side):
-        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
-                                        side.msk):
-            slabs.append((jnp.asarray(rows), jnp.asarray(idx),
-                          jnp.asarray(vals), jnp.asarray(msk)))
+    slabs = (als.device_slabs(packed.user_side, packed.n_items,
+                              jnp.bfloat16)
+             + als.device_slabs(packed.item_side, packed.n_users,
+                                jnp.bfloat16))
     x0, y0 = als.init_factors(packed.n_users, packed.n_items, packed.rank,
                               SEED)
     x0, y0 = jnp.asarray(x0), jnp.asarray(y0)
@@ -236,9 +242,9 @@ def _ml25m_phase_breakdown(packed):
         def body(_, acc):
             yy = (y + acc * 1e-30).astype(jnp.bfloat16)
             a = acc
-            for rows, idx, vals, msk in slabs:
+            for rows, idx, vals in slabs:
                 B, K = idx.shape
-                i2 = idx.reshape(B // 2, 2, K)
+                i2 = jnp.maximum(idx, 0).reshape(B // 2, 2, K)
                 a = a + yy[i2[:, 0]].sum().astype(jnp.float32) \
                       + yy[i2[:, 1]].sum().astype(jnp.float32)
             return a
@@ -276,13 +282,10 @@ def _compiler_peak_bytes(packed):
 
     from predictionio_tpu.ops import als
 
-    slabs_u, slabs_i = [], []
-    for side, out in ((packed.user_side, slabs_u),
-                      (packed.item_side, slabs_i)):
-        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
-                                        side.msk):
-            out.append((jnp.asarray(rows), jnp.asarray(idx),
-                        jnp.asarray(vals), jnp.asarray(msk)))
+    slabs_u = als.device_slabs(packed.user_side, packed.n_items,
+                               jnp.bfloat16)
+    slabs_i = als.device_slabs(packed.item_side, packed.n_users,
+                               jnp.bfloat16)
     x0, y0 = als.init_factors(packed.n_users, packed.n_items, packed.rank,
                               SEED)
     lowered = als._run_als.lower(
@@ -325,9 +328,7 @@ def bench_ml25m():
                               rank=ML25M_RANK)
     pack_s = time.perf_counter() - t0
     flops_iter = als.iteration_flops(packed)
-    padded_entries = sum(ix.size for side in (packed.user_side,
-                                              packed.item_side)
-                         for ix in side.idx)
+    padded_entries = _padded_entries(packed)
 
     # end-to-end wall-clock, cold then warm (cold includes XLA compile)
     t0 = time.perf_counter()

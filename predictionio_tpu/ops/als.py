@@ -45,7 +45,8 @@ XLA program:
 
 Memory model (per device, D devices, f32):
   persistent:  |X|/D + |Y|/D factor shards, + slab columns /D
-               (idx 4B + val 4B + msk 4B per rating entry, both sides)
+               (idx 4B + val 4B per padded entry, both sides; the mask
+               derives from the -1 idx sentinel, never materialized)
   transient :  the all-gathered opposite factor matrix (|Y| or |X|) +
                the gathered slab factors [rows_b, cap_b, rank] per bucket
                (~ratings_on_device * rank * 4B for the largest bucket).
@@ -122,12 +123,33 @@ _SLAB_NORMAL_BUDGET = 512 << 20
 
 @dataclass
 class _SideBuckets:
-    """Padded CSR slabs for one side (one entry per bucket)."""
+    """Degree-bucketed CSR for one side (one entry per bucket chunk).
+
+    Entries are stored RAGGED (per-row counts + concatenated idx/val):
+    the host->device link is the scarce resource on this runtime
+    (~25 MB/s tunnel, measured r4), so only real entries ever cross it —
+    padded slab forms are materialized ON DEVICE by `_pad_slab_device`
+    (hot path) or on host by `padded()` (mesh re-partitioner, direct
+    solver tests). Slot padding carries idx == -1; the mask is derived
+    from it device-side, never stored or transferred."""
     rows: List[np.ndarray]     # [rows_b] row indexes into this side
-    idx: List[np.ndarray]      # [rows_b, cap_b] opposite-side indexes
-    val: List[np.ndarray]      # [rows_b, cap_b] ratings (0 = padding)
-    msk: List[np.ndarray]      # [rows_b, cap_b] 1.0 valid / 0.0 padding
+    counts: List[np.ndarray]   # [rows_b] real entries per row
+    idx: List[np.ndarray]      # [entries_b] ragged opposite-side indexes
+    val: List[np.ndarray]      # [entries_b] ragged ratings
+    caps: List[int]            # bucket cap (padded row width) per chunk
     n_rows: int
+
+    def padded(self, j: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host materialization of chunk j as ([rows_b, cap] idx with -1
+        padding, [rows_b, cap] val)."""
+        counts, cap = self.counts[j], self.caps[j]
+        nb = len(counts)
+        member, intra = _group_offsets(counts)
+        idx = np.full((nb, cap), -1, np.int32)
+        val = np.zeros((nb, cap), np.float32)
+        idx[member, intra] = self.idx[j]
+        val[member, intra] = self.val[j]
+        return idx, val
 
 
 def _group_offsets(counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -155,22 +177,16 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
     # bucket cap per unique row: smallest ladder cap >= count
     ladder = _cap_ladder(int(counts.max()) if len(counts) else _BUCKET_BASE)
     caps_per_row = ladder[np.searchsorted(ladder, counts)]
-    out = _SideBuckets([], [], [], [], n_rows)
+    out = _SideBuckets([], [], [], [], [], n_rows)
     for cap in np.unique(caps_per_row):
         sel = caps_per_row == cap
         rows = uniq[sel].astype(np.int32)
         m_starts, m_counts = starts[sel], counts[sel]
         nb = len(rows)
-        # ragged -> padded scatter: flat source index for every entry and
-        # its (member, intra-row offset) destination, all vectorized
+        # ragged entries in row order: flat source index for every entry
         member_of, intra = _group_offsets(m_counts)
         src = np.repeat(m_starts, m_counts) + intra
-        idx = np.zeros((nb, cap), np.int32)
-        vals = np.zeros((nb, cap), np.float32)
-        msk = np.zeros((nb, cap), np.float32)
-        idx[member_of, intra] = c[src]
-        vals[member_of, intra] = v[src]
-        msk[member_of, intra] = 1.0
+        ends = np.cumsum(m_counts)
         if rank is None:
             chunk = nb
         else:
@@ -179,19 +195,78 @@ def _pack_side(row_ix: np.ndarray, col_ix: np.ndarray, val: np.ndarray,
             chunk -= chunk % 2   # paired solver consumes rows two at a time
         for s in range(0, nb, max(chunk, 1)):
             e = min(s + chunk, nb)
-            rws, ix, vl, mk = rows[s:e], idx[s:e], vals[s:e], msk[s:e]
+            rws, cnts = rows[s:e], m_counts[s:e].astype(np.int32)
+            lo = ends[s - 1] if s else 0
+            src_se = src[lo:ends[e - 1]]
             if len(rws) % 2:
-                # pad to even rows for the paired solver; the fill row is
-                # dropped at scatter time (see _FILL_ROW)
+                # pad to even rows for the paired solver; the fill row
+                # (count 0) is dropped at scatter time (see _FILL_ROW)
                 rws = np.concatenate([rws, np.asarray([_FILL_ROW], np.int32)])
-                ix = np.concatenate([ix, np.zeros((1, cap), np.int32)])
-                vl = np.concatenate([vl, np.zeros((1, cap), np.float32)])
-                mk = np.concatenate([mk, np.zeros((1, cap), np.float32)])
+                cnts = np.concatenate([cnts, np.zeros(1, np.int32)])
             out.rows.append(rws)
-            out.idx.append(ix)
-            out.val.append(vl)
-            out.msk.append(mk)
+            out.counts.append(cnts)
+            out.idx.append(c[src_se].astype(np.int32))
+            out.val.append(v[src_se].astype(np.float32))
+            out.caps.append(int(cap))
     return out
+
+
+@partial(jax.jit, static_argnames=("meta",))
+def _pad_side_device(rows_c, counts_c, idx_c, val_c, *, meta):
+    """Device-side ragged -> padded materialization of a whole side in
+    ONE compiled program (a per-chunk program would compile ~40 tiny
+    kernels, each paying the runtime's compile round trip — measured
+    +440 s cold on the ML-25M pack). Inputs are the side's chunks
+    CONCATENATED; `meta` is the static ((rows_j, entries_j, cap_j), ...)
+    chunk table. Returns a tuple of (rows, idx, val) per chunk, idx
+    carrying -1 slot padding (the mask derives from it downstream)."""
+    import jax.numpy as jnp
+
+    out = []
+    ro = eo = 0
+    for nb, ne, cap in meta:
+        rows = jax.lax.slice(rows_c, (ro,), (ro + nb,))
+        counts = jax.lax.slice(counts_c, (ro,), (ro + nb,))
+        ridx = jax.lax.slice(idx_c, (eo,), (eo + ne,))
+        rval = jax.lax.slice(val_c, (eo,), (eo + ne,))
+        member = jnp.repeat(jnp.arange(nb, dtype=jnp.int32), counts,
+                            total_repeat_length=ne)
+        starts = jnp.cumsum(counts) - counts
+        intra = jnp.arange(ne, dtype=jnp.int32) - jnp.repeat(
+            starts.astype(jnp.int32), counts, total_repeat_length=ne)
+        idx = jnp.full((nb, cap), -1, jnp.int32)
+        idx = idx.at[member, intra].set(ridx.astype(jnp.int32))
+        val = jnp.zeros((nb, cap), rval.dtype)
+        val = val.at[member, intra].set(rval)
+        out.append((rows, idx, val))
+        ro += nb
+        eo += ne
+    return tuple(out)
+
+
+def device_slabs(side: _SideBuckets, n_opposite: int,
+                 val_dtype=np.float32) -> List[tuple]:
+    """Upload one side's slabs as (rows, padded idx, padded val) device
+    tuples. Transfer-lean: ragged entries only (no padding, no mask
+    plane), indexes narrowed to uint16 when the opposite side fits, and
+    `val_dtype` (bfloat16 on the paired hot path) halving value bytes —
+    the measured v5e tunnel moves ~25 MB/s, so these bytes are
+    wall-clock 1:1 at ML-25M scale. Four uploads + one compiled pad
+    program per side signature."""
+    import jax.numpy as jnp
+
+    idx_t = np.uint16 if n_opposite <= np.iinfo(np.uint16).max else np.int32
+    meta = tuple((len(side.counts[j]), len(side.idx[j]), side.caps[j])
+                 for j in range(len(side.rows)))
+    if not meta:
+        return []
+    padded = _pad_side_device(
+        jnp.asarray(np.concatenate(side.rows)),
+        jnp.asarray(np.concatenate(side.counts)),
+        jnp.asarray(np.concatenate(side.idx).astype(idx_t)),
+        jnp.asarray(np.concatenate(side.val).astype(val_dtype)),
+        meta=meta)
+    return list(padded)
 
 
 @dataclass
@@ -238,8 +313,8 @@ def iteration_flops(packed: PackedRatings,
     total = 0
     paired = r > _SMALL_RANK
     for side in (packed.user_side, packed.item_side):
-        for idx in side.idx:
-            b, k = idx.shape
+        for rows, k in zip(side.rows, side.caps):
+            b = len(rows)
             if paired:
                 total += 4 * b * k * r * r + 2 * b * k * r
                 total += b * cg_iters * (4 * r * r + 16 * r)
@@ -251,11 +326,12 @@ def iteration_flops(packed: PackedRatings,
 
 
 @partial(jax.jit, static_argnames=("implicit",))
-def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
+def _solve_bucket(factors, idx, val, reg, alpha, yty, *, implicit: bool):
     """Solve normal equations for one bucket slab — the exact f32 path.
 
     factors: [n_opposite, rank] opposite-side factors (replicated)
-    idx/val/msk: [rows_b, cap_b]
+    idx/val: [rows_b, cap_b]; slot padding carries idx == -1 (the mask
+    is derived here — it never crosses the host->device link)
     yty: [rank, rank] Gram matrix of opposite factors (implicit only)
     Returns [rows_b, rank] solutions.
 
@@ -271,7 +347,9 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
     from predictionio_tpu.ops.linalg import pcg_solve, spd_solve
 
     rank = factors.shape[1]
-    yg = factors[idx]                                   # [B, K, R] gather
+    msk = (idx >= 0).astype(factors.dtype)              # [B, K]
+    val = val.astype(factors.dtype)
+    yg = factors[jnp.maximum(idx, 0)]                   # [B, K, R] gather
     if implicit:
         # MLlib trainImplicit semantics: confidence c = 1 + alpha*|r|,
         # preference p = 1 iff r > 0 (negative r = confident dislike)
@@ -295,7 +373,7 @@ def _solve_bucket(factors, idx, val, msk, reg, alpha, yty, *, implicit: bool):
 
 
 @partial(jax.jit, static_argnames=("implicit", "cg_iters", "cast"))
-def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
+def _solve_slab_paired(own, opp_cast, rows, idx, val, reg, alpha, yty,
                        *, implicit: bool, cg_iters: int, cast):
     """The TPU hot-loop slab solver: paired-rows Gram on full MXU tiles +
     warm-started CG. Returns ([rows_b, R] solutions, [rows_b] relative
@@ -336,7 +414,7 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
     R = own.shape[1]
     B = idx.shape[0]
     G = B // 2
-    a2, b2, n2 = _paired_normal_eqs(opp_cast, idx, val, msk, reg, alpha,
+    a2, b2, n2 = _paired_normal_eqs(opp_cast, idx, val, reg, alpha,
                                     yty, implicit=implicit, cast=cast)
     live2 = n2 > 0                                       # [G, 2R]
     r2 = rows.reshape(G, 2)
@@ -359,7 +437,7 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, msk, reg, alpha, yty,
                           rel_b, 0.0)
 
 
-def _paired_normal_eqs(opp_cast, idx, val, msk, reg, alpha, yty, *,
+def _paired_normal_eqs(opp_cast, idx, val, reg, alpha, yty, *,
                        implicit: bool, cast):
     """Build the per-PAIR normal equations (A2 [B/2, 2R, 2R] f32
     block-diagonal, b2 [B/2, 2R] f32, n2 [B/2, 2R] per-lane row counts)
@@ -381,8 +459,11 @@ def _paired_normal_eqs(opp_cast, idx, val, msk, reg, alpha, yty, *,
     prec = (jax.lax.Precision.DEFAULT if cast == jnp.bfloat16
             else jax.lax.Precision.HIGHEST)
     i2 = idx.reshape(G, 2, K)
-    v2 = val.reshape(G, 2, K)
-    m2 = msk.reshape(G, 2, K)
+    # slot padding carries idx == -1; derive the mask on device and
+    # clamp for the gather (mask zeroes the garbage row's contribution)
+    m2 = (i2 >= 0).astype(jnp.float32)
+    i2 = jnp.maximum(i2, 0)
+    v2 = val.reshape(G, 2, K).astype(jnp.float32)
     if implicit:
         # eps keeps c==0 observed entries alive through the sqrt trick:
         # their A-weight becomes eps (harmless) and the b-weight below
@@ -439,10 +520,10 @@ def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
     LOCAL index (row - d*block, fill = block -> dropped scatter).
     Host-side, vectorized."""
     packed = []
-    for rows, idx, vals, msk in zip(side.rows, side.idx, side.val, side.msk):
+    for j, rows in enumerate(side.rows):
+        idx, vals = side.padded(j)
         real = rows != _FILL_ROW           # _pack_side even-padding rows
-        rows, idx = rows[real], idx[real]
-        vals, msk = vals[real], msk[real]
+        rows, idx, vals = rows[real], idx[real], vals[real]
         owner = rows // block
         counts = np.bincount(owner, minlength=n_dev)
         rb = max(int(counts.max()), 1)
@@ -450,17 +531,15 @@ def _pack_by_owner(side: _SideBuckets, block: int, n_dev: int):
         order = np.argsort(owner, kind="stable")
         member, intra = _group_offsets(counts)
         local_rows = np.full((n_dev, rb), block, np.int32)
-        d_idx = np.zeros((n_dev, rb) + idx.shape[1:], idx.dtype)
+        # fill slabs keep the -1 idx sentinel (mask derives from it)
+        d_idx = np.full((n_dev, rb) + idx.shape[1:], -1, idx.dtype)
         d_val = np.zeros((n_dev, rb) + vals.shape[1:], vals.dtype)
-        d_msk = np.zeros((n_dev, rb) + msk.shape[1:], msk.dtype)
         local_rows[member, intra] = rows[order] - member * block
         d_idx[member, intra] = idx[order]
         d_val[member, intra] = vals[order]
-        d_msk[member, intra] = msk[order]
         packed.append((local_rows.reshape(n_dev * rb),
                        d_idx.reshape((n_dev * rb,) + idx.shape[1:]),
-                       d_val.reshape((n_dev * rb,) + vals.shape[1:]),
-                       d_msk.reshape((n_dev * rb,) + msk.shape[1:])))
+                       d_val.reshape((n_dev * rb,) + vals.shape[1:])))
     return packed
 
 
@@ -490,9 +569,9 @@ def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
                             else opp_local)
                 opp_full = jax.lax.all_gather(opp_cast, "data", axis=0,
                                               tiled=True)
-                for local_rows, idx, vals, msk in slabs:
+                for local_rows, idx, vals in slabs:
                     sol, rel = _solve_slab_paired(
-                        own_local, opp_full, local_rows, idx, vals, msk,
+                        own_local, opp_full, local_rows, idx, vals,
                         reg, alpha, yty, implicit=implicit,
                         cg_iters=cg_iters, cast=cast or jnp.float32)
                     own_local = own_local.at[local_rows].set(sol,
@@ -501,8 +580,8 @@ def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
             else:
                 opp_full = jax.lax.all_gather(opp_local, "data", axis=0,
                                               tiled=True)
-                for local_rows, idx, vals, msk in slabs:
-                    sol = _solve_bucket(opp_full, idx, vals, msk, reg,
+                for local_rows, idx, vals in slabs:
+                    sol = _solve_bucket(opp_full, idx, vals, reg,
                                         alpha, yty, implicit=implicit)
                     # fill rows carry local index == block -> dropped
                     own_local = own_local.at[local_rows].set(sol,
@@ -544,7 +623,8 @@ def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
              cast=None):
     """The full ALS training loop as one compiled program (module-level
     jit: the cache persists across als_train calls with the same slab
-    shapes). Slabs are pytrees of (rows, idx, val, msk) tuples. Returns
+    shapes). Slabs are pytrees of (rows, idx, val) tuples (mask derives
+    from the -1 idx sentinel on device). Returns
     (x, y, max relative solver residual — 0.0 on the exact small-rank
     path)."""
     import jax.numpy as jnp
@@ -556,15 +636,15 @@ def _run_als(x, y, user_slabs, item_slabs, reg, alpha, n_iter, *,
                else jnp.zeros((rank, rank), jnp.float32))
         opp_cast = (opposite.astype(cast) if (paired and cast is not None)
                     else opposite)
-        for rows_dev, idx, vals, msk in slabs:
+        for rows_dev, idx, vals in slabs:
             if paired:
                 sol, rel = _solve_slab_paired(
-                    own, opp_cast, rows_dev, idx, vals, msk, reg, alpha,
+                    own, opp_cast, rows_dev, idx, vals, reg, alpha,
                     yty, implicit=implicit, cg_iters=cg_iters,
                     cast=cast or jnp.float32)
                 res = jnp.maximum(res, rel.max())
             else:
-                sol = _solve_bucket(opposite, idx, vals, msk, reg, alpha,
+                sol = _solve_bucket(opposite, idx, vals, reg, alpha,
                                     yty, implicit=implicit)
             # slab-padding rows carry an out-of-bounds row index; 'drop'
             # discards their updates instead of clamping onto row n-1
@@ -746,14 +826,14 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
                            fetch_s=_time.perf_counter() - t_solve)
         return out
 
-    dev_sides = []
-    for side in (user_side, item_side):
-        slabs = []
-        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
-                                        side.msk):
-            slabs.append((jnp.asarray(rows), jnp.asarray(idx),
-                          jnp.asarray(vals), jnp.asarray(msk)))
-        dev_sides.append(slabs)
+    # transfer-lean upload: ragged entries only, uint16 idx when the
+    # opposite side fits, bf16 values on the paired hot path (exact for
+    # half-star ratings; the f32 escape hatch is precision="f32")
+    paired = rank > _SMALL_RANK
+    val_dt = (jnp.bfloat16 if (paired and cast is jnp.bfloat16)
+              else np.float32)
+    dev_sides = [device_slabs(user_side, n_items, val_dt),
+                 device_slabs(item_side, n_users, val_dt)]
     jax.block_until_ready(dev_sides)
     t_xfer = _time.perf_counter()
 
@@ -828,8 +908,11 @@ def hbm_footprint(n_users: int, n_items: int, n_ratings: int, rank: int,
     padded_user = pad_side * n_users + _BUCKET_GROWTH * n_ratings
     padded_item = pad_side * n_items + _BUCKET_GROWTH * n_ratings
     factors_local = (n_users + n_items) * rank * fb / n_devices
-    # idx + val + msk per PADDED entry, both sides, sharded with skew
-    slabs_local = ((padded_user + padded_item) * 3 * fb / n_devices
+    # idx (int32) + val (f32 bound; the bf16 hot path halves it) per
+    # PADDED entry, both sides, sharded with skew — the mask plane is
+    # derived from the -1 idx sentinel and never materialized
+    # persistently
+    slabs_local = ((padded_user + padded_item) * 2 * fb / n_devices
                    * owner_skew)
     gathered_opposite = max(n_users, n_items) * rank * fb
     # Multipliers anchored to the compiler's buffer assignment for the

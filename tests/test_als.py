@@ -49,11 +49,11 @@ class TestHalfStepOracle:
         side = als._pack_side(u_ix, i_ix, val, 40)
         import jax.numpy as jnp
         x = np.zeros((40, 3), np.float32)
-        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
-                                        side.msk):
+        for j, rows in enumerate(side.rows):
+            idx, vals = side.padded(j)
             sol = als._solve_bucket(
                 jnp.asarray(y), jnp.asarray(idx), jnp.asarray(vals),
-                jnp.asarray(msk), jnp.float32(0.1), jnp.float32(1.0),
+                jnp.float32(0.1), jnp.float32(1.0),
                 jnp.zeros((3, 3), jnp.float32), implicit=False)
             x[rows] = np.asarray(sol)
         oracle = numpy_user_step(y, u_ix, i_ix, val, 40, 0.1)
@@ -68,11 +68,11 @@ class TestHalfStepOracle:
         import jax.numpy as jnp
         yty = jnp.asarray(y.T @ y)
         x = np.zeros((40, 3), np.float32)
-        for rows, idx, vals, msk in zip(side.rows, side.idx, side.val,
-                                        side.msk):
+        for j, rows in enumerate(side.rows):
+            idx, vals = side.padded(j)
             sol = als._solve_bucket(
                 jnp.asarray(y), jnp.asarray(idx), jnp.asarray(vals),
-                jnp.asarray(msk), jnp.float32(0.1), jnp.float32(2.0),
+                jnp.float32(0.1), jnp.float32(2.0),
                 yty, implicit=True)
             x[rows] = np.asarray(sol)
         oracle = numpy_user_step_implicit(y, u_ix, i_ix, val, 40, 0.1, 2.0)
@@ -235,7 +235,8 @@ class TestSlabSplitting:
         # Gram term dominates and is quadratic in rank
         assert als.iteration_flops(p8) > 3 * als.iteration_flops(p4)
         # padded entries >= real entries
-        padded = sum(ix.size for ix in p4.user_side.idx)
+        padded = sum(len(r) * c for r, c in zip(p4.user_side.rows,
+                                                p4.user_side.caps))
         assert padded >= len(u)
 
     def test_timings_dict_is_filled(self):
