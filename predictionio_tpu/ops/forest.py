@@ -353,7 +353,12 @@ def forest_train(features: np.ndarray, labels: np.ndarray, *,
     else:
         w = jax.random.poisson(kboot, 1.0, (n_trees, n)).astype(jnp.float32)
 
-    fb_cols_np = xb_np + np.arange(f)[None, :] * max_bins
+    # binned features cross the host->device link at uint8 (max_bins is
+    # bounded at 256) and widen device-side; fb_cols is DERIVED on
+    # device — together this cuts the 1Mx100 upload from 720 MB of int32
+    # to 90 MB, and the measured bench tunnel moves ~25 MB/s
+    xb_small = (xb_np.astype(np.uint8) if max_bins <= 256
+                else xb_np.astype(np.int32))
     y_np32 = y_np.astype(np.int32)
     if mesh is not None:
         # pad samples to a device multiple with weight-0 rows (invisible
@@ -362,14 +367,13 @@ def forest_train(features: np.ndarray, labels: np.ndarray, *,
 
         n_dev = int(mesh.shape["data"])
         npad = pad_to_multiple(max(n, n_dev), n_dev)
-        fb_cols_np = pad_rows(fb_cols_np, npad)
-        xb_np = pad_rows(xb_np, npad)
+        xb_small = pad_rows(xb_small, npad)
         y_np32 = pad_rows(y_np32, npad)
         w = jnp.pad(w, ((0, 0), (0, npad - n)))
         n = npad
-    fb_cols = jnp.asarray(fb_cols_np)
+    xb = jnp.asarray(xb_small).astype(jnp.int32)
+    fb_cols = xb + jnp.arange(f, dtype=jnp.int32)[None, :] * max_bins
     y = jnp.asarray(y_np32)
-    xb = jnp.asarray(xb_np)
     node = jnp.zeros((n_trees, n), jnp.int32)
 
     split_fs, split_bs = [], []

@@ -104,28 +104,56 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
-    @jax.jit
     def step(params, opt_state, ub, ib):
         loss, grads = jax.value_and_grad(_loss_fn)(params, ub, ib,
                                                    temperature)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    rng = np.random.RandomState(seed)
+    steps_per_epoch = max(n // batch_size, 1)
     if mesh is not None:
+        # sharded batches arrive via device_put per step (the epoch data
+        # is resharded by the mesh's batch sharding); dispatch overhead
+        # is irrelevant under the virtual test meshes
         from predictionio_tpu.parallel import batch_sharding
         sharding = batch_sharding(mesh)          # dim 0 over "data"
         data_size = int(mesh.shape.get("data", 1))
-    rng = np.random.RandomState(seed)
-    steps_per_epoch = max(n // batch_size, 1)
-    for _ in range(epochs):
-        order = rng.permutation(n)
-        for s in range(steps_per_epoch):
-            sel = order[s * batch_size:(s + 1) * batch_size]
-            ub, ib = jnp.asarray(u_ix[sel]), jnp.asarray(i_ix[sel])
-            if mesh is not None and len(sel) % data_size == 0:
-                ub = jax.device_put(ub, sharding)
-                ib = jax.device_put(ib, sharding)
-            params, opt_state, loss = step(params, opt_state, ub, ib)
+        step = jax.jit(step)
+        for _ in range(epochs):
+            order = rng.permutation(n)
+            for s in range(steps_per_epoch):
+                sel = order[s * batch_size:(s + 1) * batch_size]
+                ub, ib = jnp.asarray(u_ix[sel]), jnp.asarray(i_ix[sel])
+                if len(sel) % data_size == 0:
+                    ub = jax.device_put(ub, sharding)
+                    ib = jax.device_put(ib, sharding)
+                params, opt_state, loss = step(params, opt_state, ub, ib)
+    else:
+        # single-device: ONE dispatch per epoch via lax.scan over the
+        # pre-uploaded shuffled batches. A per-step dispatch pays the
+        # host round trip hundreds of times per epoch (~100 ms each on
+        # the tunneled bench runtime — the epoch would be RTT-bound,
+        # not compute-bound)
+        @jax.jit
+        def epoch(params, opt_state, ub_all, ib_all):
+            def body(carry, batch):
+                p, o = carry
+                ub, ib = batch
+                p, o, loss = step(p, o, ub, ib)
+                return (p, o), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (ub_all, ib_all))
+            return params, opt_state, losses
+
+        m = steps_per_epoch * batch_size
+        for _ in range(epochs):
+            order = rng.permutation(n)[:m]
+            ub_all = jnp.asarray(
+                u_ix[order].reshape(steps_per_epoch, batch_size))
+            ib_all = jnp.asarray(
+                i_ix[order].reshape(steps_per_epoch, batch_size))
+            params, opt_state, _ = epoch(params, opt_state, ub_all, ib_all)
 
     user_emb = _tower(params["user_table"], params["user_w1"],
                       params["user_w2"], jnp.arange(n_users))
