@@ -67,10 +67,20 @@ TPU_PEAK_FLOPS = {
 }
 
 
+# When a section runs under `section()` (the retry wrapper), metrics
+# buffer here so a retried section REPLACES its earlier values instead
+# of printing duplicate metric lines; the buffer flushes after the
+# section's final attempt. Direct calls (tests, --smoke) stream.
+_METRIC_BUFFER = None
+
+
 def emit(metric, value, unit, vs_baseline):
-    print(json.dumps({"metric": metric, "value": round(value, 4),
-                      "unit": unit, "vs_baseline": round(vs_baseline, 2)}),
-          flush=True)
+    rec = {"metric": metric, "value": round(value, 4),
+           "unit": unit, "vs_baseline": round(vs_baseline, 2)}
+    if _METRIC_BUFFER is not None:
+        _METRIC_BUFFER[metric] = rec
+    else:
+        print(json.dumps(rec), flush=True)
 
 
 def synthetic_ml100k(seed=0):
@@ -1086,34 +1096,57 @@ def bench_twotower(n_events: int = 200_000):
          recall / (10 / n_items))
 
 
+def section(fn, *a):
+    """Run one bench section with buffered metrics and ONE retry: the
+    bench runtime's compile service occasionally drops a connection
+    mid-build (remote_compile 'response body closed'); the retry
+    distinguishes that transient from a real failure without losing the
+    whole run's metrics, and the buffer makes the retry REPLACE the
+    aborted attempt's metric lines instead of duplicating them."""
+    global _METRIC_BUFFER
+    _METRIC_BUFFER = {}
+    try:
+        try:
+            return fn(*a)
+        except Exception as e:
+            print(f"# section {fn.__name__} failed ({e!r:.200}); "
+                  "retrying once", file=sys.stderr)
+            _METRIC_BUFFER.clear()
+            return fn(*a)
+    finally:
+        for rec in _METRIC_BUFFER.values():
+            print(json.dumps(rec), flush=True)
+        _METRIC_BUFFER = None
+
+
 def main():
     if "--only-ml25m" in sys.argv:
-        bench_ml25m()
+        section(bench_ml25m)
         return
     if "--only-pevlog" in sys.argv:
-        bench_pevlog()
+        section(bench_pevlog)
         return
     if "--only-large-catalog" in sys.argv:
-        bench_serving_large_catalog()
+        section(bench_serving_large_catalog)
         return
     if "--only-configs" in sys.argv:   # BASELINE configs 2-5
-        bench_classification()
-        bench_similarproduct()
-        bench_ecommerce()
-        bench_twotower()
+        section(bench_classification)
+        section(bench_similarproduct)
+        section(bench_ecommerce)
+        section(bench_twotower)
         return
-    bench_ml25m()
-    bench_serving_large_catalog()
-    bench_pevlog()
-    bench_classification()
-    bench_similarproduct()
-    bench_ecommerce()
-    bench_twotower()
+    section(bench_ml25m)
+    section(bench_serving_large_catalog)
+    section(bench_pevlog)
+    section(bench_classification)
+    section(bench_similarproduct)
+    section(bench_ecommerce)
+    section(bench_twotower)
     u, i, r, n_users, n_items = synthetic_ml100k()
-    oracle_train_s = bench_rmse_parity(u, i, r, n_users, n_items)
-    bench_serving(u, i, r, n_users, n_items)
+    oracle_train_s = section(bench_rmse_parity, u, i, r, n_users, n_items)
+    section(bench_serving, u, i, r, n_users, n_items)
     # headline metric last (the driver parses the final JSON line)
-    bench_train(u, i, r, n_users, n_items, oracle_train_s)
+    section(bench_train, u, i, r, n_users, n_items, oracle_train_s)
 
 
 if __name__ == "__main__":

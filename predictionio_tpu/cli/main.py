@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(jax.distributed); or set PIO_TPU_COORDINATOR")
     x.add_argument("--num-processes", type=int)
     x.add_argument("--process-id", type=int)
+    x.add_argument("--profile-dir",
+                   help="write a jax.profiler device trace here "
+                        "(TensorBoard-loadable); or set "
+                        "PIO_TPU_PROFILE_DIR")
     x = sub.add_parser("eval")
     x.add_argument("evaluation", help="dotted path to an Evaluation")
     x.add_argument("params_generator", nargs="?",
@@ -227,7 +231,8 @@ def main(argv: Optional[list] = None) -> int:
                 stop_after_prepare=args.stop_after_prepare,
                 coordinator=args.coordinator,
                 num_processes=args.num_processes,
-                process_id=args.process_id))
+                process_id=args.process_id,
+                profile_dir=args.profile_dir))
             return 0
         if cmd == "eval":
             _emit(ops.run_eval(_registry(), args.evaluation,

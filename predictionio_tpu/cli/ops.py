@@ -7,6 +7,7 @@ Import}.scala`.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -182,8 +183,13 @@ def train(registry, *, engine_json: str = "engine.json",
           stop_after_prepare: bool = False,
           coordinator: Optional[str] = None,
           num_processes: Optional[int] = None,
-          process_id: Optional[int] = None) -> Dict[str, Any]:
+          process_id: Optional[int] = None,
+          profile_dir: Optional[str] = None) -> Dict[str, Any]:
     """pio train (commands/Engine.scala:177-188 -> CreateWorkflow).
+
+    `profile_dir` (or PIO_TPU_PROFILE_DIR) wraps the whole run in
+    `jax.profiler.trace`: a TensorBoard-loadable device trace next to
+    the per-phase wall-clock the EngineInstance always records.
 
     Multi-host: `--coordinator host:port --num-processes N --process-id K`
     (or the PIO_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID env vars)
@@ -220,14 +226,24 @@ def train(registry, *, engine_json: str = "engine.json",
     if distributed:
         import jax
         persist = jax.process_index() == 0
-    row = CoreWorkflow.run_train(
-        engine, engine_params, ctx,
-        engine_factory=factory,
-        engine_variant=variant.get("id", "default"),
-        persist=persist)
+
+    import contextlib
+    profile_dir = profile_dir or os.environ.get("PIO_TPU_PROFILE_DIR")
+    if profile_dir:
+        import jax
+        prof_ctx = jax.profiler.trace(profile_dir)
+    else:
+        prof_ctx = contextlib.nullcontext()
+    with prof_ctx:
+        row = CoreWorkflow.run_train(
+            engine, engine_params, ctx,
+            engine_factory=factory,
+            engine_variant=variant.get("id", "default"),
+            persist=persist)
     return {"engineInstanceId": row.id, "status": row.status,
             "startTime": format_time(row.start_time),
             "endTime": format_time(row.end_time),
+            "phaseTimings": dict(ctx.phase_timings),
             "distributed": distributed, "persisted": persist}
 
 

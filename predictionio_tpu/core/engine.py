@@ -69,22 +69,32 @@ class Engine:
     # -- train (Engine.scala:157-192 + 643-708) -----------------------------
     def train(self, ctx: RuntimeContext,
               engine_params: EngineParams) -> List[Any]:
+        import time as _time
+
         ds, prep, algos, _ = self.make_components(engine_params)
         bind_serving_context(algos, ctx)
         wp = ctx.workflow_params
+        tm = ctx.phase_timings
+        t0 = _time.perf_counter()
         td = ds.read_training(ctx)
+        tm["read_s"] = round(_time.perf_counter() - t0, 4)
         if not wp.skip_sanity_check:
             sanity_check(td)
         if wp.stop_after_read:
             raise StopAfterReadInterruption()
+        t0 = _time.perf_counter()
         pd = prep.prepare(ctx, td)
+        tm["prepare_s"] = round(_time.perf_counter() - t0, 4)
         if not wp.skip_sanity_check:
             sanity_check(pd)
         if wp.stop_after_prepare:
             raise StopAfterPrepareInterruption()
         models = []
-        for algo in algos:       # sequential per-algo loop (Engine.scala:692)
+        for i, algo in enumerate(algos):
+            # sequential per-algo loop (Engine.scala:692)
+            t0 = _time.perf_counter()
             model = algo.train(ctx, pd)
+            tm[f"train_algo{i}_s"] = round(_time.perf_counter() - t0, 4)
             if not wp.skip_sanity_check:
                 sanity_check(model)
             models.append(model)

@@ -12,7 +12,7 @@ TPU-native analog bundles:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Mapping, Optional
+from typing import Any, Mapping, Optional
 
 from predictionio_tpu.parallel import MeshSpec, make_mesh
 
@@ -25,7 +25,7 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
-    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+    runtime_conf: Mapping[str, Any] = field(default_factory=dict)
 
 
 class RuntimeContext:
@@ -36,6 +36,11 @@ class RuntimeContext:
         self._registry = registry
         self._mesh = mesh
         self.workflow_params = workflow_params or WorkflowParams()
+        # per-phase wall-clock filled by Engine.train (read/prepare/
+        # per-algo), persisted into the EngineInstance runtime_conf —
+        # the per-run tracing record the reference keeps only as
+        # start/end times (CoreWorkflow.scala:45-101)
+        self.phase_timings: dict = {}
 
     @property
     def registry(self):

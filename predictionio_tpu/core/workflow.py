@@ -122,8 +122,13 @@ class CoreWorkflow:
             _, _, algos, _ = engine.make_components(engine_params)
             blob = serialize_models(instance_id, algos, models, ctx)
             registry.get_model_data_models().insert(Model(instance_id, blob))
-            row = row.with_(status=EngineInstanceStatus.COMPLETED,
-                            end_time=utcnow())
+            row = row.with_(
+                status=EngineInstanceStatus.COMPLETED, end_time=utcnow(),
+                # per-phase timings travel with the instance: `pio
+                # status`/dashboard can show WHERE a train spent its
+                # time, not just start/end
+                runtime_conf={**row.runtime_conf,
+                              "phase_timings": dict(ctx.phase_timings)})
             instances.update(row)
             return row
         except Exception:

@@ -22,7 +22,7 @@ import secrets
 import re
 from dataclasses import dataclass, field, replace
 from datetime import datetime
-from typing import Dict, Iterator, List, Mapping, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
 
 from predictionio_tpu.data.aggregate import aggregate_properties
 from predictionio_tpu.data.event import Event, PropertyMap, utcnow
@@ -109,7 +109,7 @@ class EngineInstance:
     engine_factory: str = ""
     batch: str = ""
     env: Mapping[str, str] = field(default_factory=dict)
-    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+    runtime_conf: Mapping[str, Any] = field(default_factory=dict)
     data_source_params: str = ""
     preparator_params: str = ""
     algorithms_params: str = ""
@@ -130,7 +130,7 @@ class EvaluationInstance:
     engine_params_generator_class: str = ""
     batch: str = ""
     env: Mapping[str, str] = field(default_factory=dict)
-    runtime_conf: Mapping[str, str] = field(default_factory=dict)
+    runtime_conf: Mapping[str, Any] = field(default_factory=dict)
     evaluator_results: str = ""
     evaluator_results_html: str = ""
     evaluator_results_json: str = ""

@@ -49,6 +49,20 @@ def _scores(pi, theta, features):
     return pi[None, :] + features @ theta.T
 
 
+def _integer_valued(a: np.ndarray) -> bool:
+    """True iff every element is a whole number. Integer dtypes answer
+    without touching the data; float inputs scan in row chunks so no
+    features-sized temporary is ever allocated."""
+    if np.issubdtype(a.dtype, np.integer) or a.dtype == bool:
+        return True
+    step = max(1, (1 << 22) // max(1, int(np.prod(a.shape[1:]))))
+    for s in range(0, a.shape[0], step):
+        chunk = a[s:s + step]
+        if not np.equal(np.mod(chunk, 1.0), 0).all():
+            return False
+    return True
+
+
 def nb_train(features: np.ndarray, labels: np.ndarray,
              lam: float = 1.0, *, mesh=None) -> NaiveBayesModel:
     """features [n, d] nonnegative; labels [n] arbitrary floats/ints.
@@ -64,13 +78,13 @@ def nb_train(features: np.ndarray, labels: np.ndarray,
     uniq = np.unique(labels)
     class_ix = np.searchsorted(uniq, labels).astype(np.int32)
     valid = np.ones(len(labels), np.float32)
-    feats_np = np.asarray(features, np.float32)
+    src = np.asarray(features)
+    feats_np = src.astype(np.float32)
     # count-like features (integers < 256 — word/event counts, the
     # multinomial NB regime) are EXACT in bfloat16: cross the
     # host->device link at half the bytes and widen device-side
     # (accumulation is f32 either way, so the statistics are identical)
-    if (feats_np.max(initial=0.0) < 256
-            and not np.mod(feats_np, 1.0).any()):
+    if feats_np.max(initial=0.0) < 256 and _integer_valued(src):
         feats_np = feats_np.astype(jnp.bfloat16)
     if mesh is not None:
         from predictionio_tpu.parallel import shard_put

@@ -242,6 +242,18 @@ class TestWorkflowPersistence:
         blob = ctx.registry.get_model_data_models().get(row.id)
         assert blob is not None
 
+    def test_run_train_records_phase_timings(self, ctx):
+        # per-phase wall-clock travels with the instance (the tracing
+        # record the reference keeps only as start/end times)
+        engine = make_engine()
+        row = CoreWorkflow.run_train(engine, ep(), ctx)
+        tm = row.runtime_conf["phase_timings"]
+        assert set(tm) >= {"read_s", "prepare_s", "train_algo0_s"}
+        assert all(v >= 0 for v in tm.values())
+        # survives the metadata round trip
+        latest = ctx.registry.get_meta_data_engine_instances().get(row.id)
+        assert "phase_timings" in latest.runtime_conf
+
     def test_failed_train_marks_failed(self, ctx):
         engine = make_engine()
         bad = EngineParams(
