@@ -75,6 +75,8 @@ class Engine:
         bind_serving_context(algos, ctx)
         wp = ctx.workflow_params
         tm = ctx.phase_timings
+        tm.clear()   # a reused context must not leak a previous run's
+        # phases into this instance's persisted record
         t0 = _time.perf_counter()
         td = ds.read_training(ctx)
         tm["read_s"] = round(_time.perf_counter() - t0, 4)

@@ -189,23 +189,67 @@ class QueryResult:
 class PgConnection:
     """One socket speaking the extended query protocol, autocommit."""
 
+    # SSLRequest magic (protocol 1234.5679, Postgres docs 55.2.10)
+    _SSL_REQUEST = struct.pack("!II", 8, 80877103)
+
     def __init__(self, host: str = "localhost", port: int = 5432, *,
                  user: str = "postgres", password: str = "",
                  database: str = "postgres", timeout: float = 10.0,
-                 allow_cleartext: bool = False):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+                 allow_cleartext: bool = False,
+                 sslmode: str = "prefer"):
+        """`sslmode` follows the libpq subset: 'disable' (never TLS),
+        'prefer' (TLS if the server supports it, else plaintext — the
+        libpq default), 'require' (TLS or fail, no cert verification),
+        'verify-full' (TLS with CA + hostname verification)."""
+        if sslmode not in ("disable", "prefer", "require", "verify-full"):
+            raise ValueError(f"unknown sslmode {sslmode!r}")
+        sock = socket.create_connection((host, port), timeout=timeout)
+        tls_verified = False
+        if sslmode != "disable":
+            sock.sendall(self._SSL_REQUEST)
+            resp = sock.recv(1)
+            if resp not in (b"S", b"N"):
+                # EOF or an ErrorResponse from a pre-SSL server:
+                # anything but S/N is a hard error (libpq semantics) —
+                # proceeding would desynchronize the protocol
+                sock.close()
+                raise PgError({"M": "SSL negotiation failed: unexpected "
+                                    f"server response {resp!r}", "C": ""})
+            if resp == b"S":
+                import ssl as _ssl
+                if sslmode == "verify-full":
+                    ctx = _ssl.create_default_context()
+                    tls_verified = True
+                else:
+                    # encryption without authentication (libpq's
+                    # require semantics): stops passive sniffing; only
+                    # verify-full defends against an active MITM
+                    ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+                    ctx.check_hostname = False
+                    ctx.verify_mode = _ssl.CERT_NONE
+                sock = ctx.wrap_socket(sock, server_hostname=host)
+            elif sslmode in ("require", "verify-full"):
+                sock.close()
+                raise PgError({"M": f"server does not support SSL but "
+                                    f"sslmode={sslmode}", "C": ""})
+            # 'N' + prefer: continue in plaintext
+        self.sock = sock
         self._buf = b""
         self.user = user
         # Cleartext password auth (AuthenticationCleartextPassword) sends
         # the password unencrypted on the socket; a MITM'd or
-        # misconfigured server could harvest it. Allowed only on loopback
-        # (where there is no wire to tap) unless explicitly opted in —
-        # md5 and SCRAM stay available everywhere.
+        # misconfigured server could harvest it. Allowed on loopback
+        # (no wire to tap) and on VERIFIED TLS channels
+        # (sslmode=verify-full — common with hosted Postgres; an
+        # unverified require/prefer channel could be attacker-terminated,
+        # so it does NOT qualify), else only by explicit opt-in — md5
+        # and SCRAM stay available everywhere.
         try:
             peer = self.sock.getpeername()[0]
         except OSError:
             peer = ""
-        self._cleartext_ok = allow_cleartext or peer in ("127.0.0.1", "::1")
+        self._cleartext_ok = (allow_cleartext or tls_verified
+                              or peer in ("127.0.0.1", "::1"))
         self.sock.sendall(encode_startup(user, database))
         self._authenticate(password)
         # drain until ReadyForQuery

@@ -827,10 +827,14 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
         return out
 
     # transfer-lean upload: ragged entries only, uint16 idx when the
-    # opposite side fits, bf16 values on the paired hot path (exact for
-    # half-star ratings; the f32 escape hatch is precision="f32")
+    # opposite side fits, bf16 values on the EXPLICIT paired hot path
+    # (exact for half-star ratings; the f32 escape hatch is
+    # precision="f32"). Implicit mode keeps f32 values: confidences
+    # c = alpha*|r| are computed in f32 from the raw ratings, and
+    # count-valued ratings above 256 would silently round in bf16.
     paired = rank > _SMALL_RANK
-    val_dt = (jnp.bfloat16 if (paired and cast is jnp.bfloat16)
+    val_dt = (jnp.bfloat16
+              if (paired and cast is jnp.bfloat16 and not implicit)
               else np.float32)
     dev_sides = [device_slabs(user_side, n_items, val_dt),
                  device_slabs(item_side, n_users, val_dt)]

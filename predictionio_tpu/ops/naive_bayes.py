@@ -79,7 +79,7 @@ def nb_train(features: np.ndarray, labels: np.ndarray,
     class_ix = np.searchsorted(uniq, labels).astype(np.int32)
     valid = np.ones(len(labels), np.float32)
     src = np.asarray(features)
-    feats_np = src.astype(np.float32)
+    feats_np = np.asarray(src, np.float32)   # zero-copy when already f32
     # count-like features (integers < 256 — word/event counts, the
     # multinomial NB regime) are EXACT in bfloat16: cross the
     # host->device link at half the bytes and widen device-side

@@ -155,8 +155,12 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
                 i_ix[order].reshape(steps_per_epoch, batch_size))
             params, opt_state, _ = epoch(params, opt_state, ub_all, ib_all)
 
-    user_emb = _tower(params["user_table"], params["user_w1"],
-                      params["user_w2"], jnp.arange(n_users))
-    item_emb = _tower(params["item_table"], params["item_w1"],
-                      params["item_w2"], jnp.arange(n_items))
+    # one jitted program per tower (eager op-by-op materialization
+    # compiles a handful of micro-programs per call; observed to tickle
+    # a flaky XLA-CPU compiler crash in long-lived test processes)
+    tower = jax.jit(_tower)
+    user_emb = tower(params["user_table"], params["user_w1"],
+                     params["user_w2"], jnp.arange(n_users))
+    item_emb = tower(params["item_table"], params["item_w1"],
+                     params["item_w2"], jnp.arange(n_items))
     return TwoTowerModel(np.asarray(user_emb), np.asarray(item_emb))

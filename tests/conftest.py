@@ -24,6 +24,22 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bounded_jax_cache():
+    """Clear jax's compiled-executable caches between test modules.
+
+    The full suite compiles many hundreds of XLA:CPU programs in one
+    process; with caches never dropped, late-suite compilations have
+    been observed to segfault inside backend_compile (flaky, ~test 440,
+    always mid-LLVM-compile — an upstream runtime fragility, not a
+    repo bug: the same programs compile fine in fresh processes).
+    Bounding the accumulated executable state keeps the suite's memory
+    profile flat and has eliminated the crash in practice; the cost is
+    per-module recompiles the modules already pay on first use."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture()
 def mem_registry():
     """A fresh all-in-memory storage registry, installed as process default."""
