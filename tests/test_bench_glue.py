@@ -1,0 +1,59 @@
+"""The bench's driver-facing glue: one JSON line per metric, retried
+sections REPLACE their metrics instead of duplicating them, and the
+buffer always flushes (the driver parses every line, final line =
+headline)."""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _lines(capsys):
+    return [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")]
+
+
+class TestEmit:
+    def test_streams_outside_sections(self, capsys):
+        bench.emit("m", 1.23456, "unit", 2.0)
+        [rec] = _lines(capsys)
+        assert rec == {"metric": "m", "value": 1.2346, "unit": "unit",
+                       "vs_baseline": 2.0}
+
+
+class TestSection:
+    def test_flushes_in_emit_order(self, capsys):
+        def ok():
+            bench.emit("a", 1, "u", 1.0)
+            bench.emit("b", 2, "u", 1.0)
+            return "ret"
+        assert bench.section(ok) == "ret"
+        assert [r["metric"] for r in _lines(capsys)] == ["a", "b"]
+
+    def test_retry_replaces_not_duplicates(self, capsys):
+        calls = []
+
+        def flaky():
+            bench.emit("m", len(calls), "u", 1.0)
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient compile drop")
+            bench.emit("late", 9, "u", 1.0)
+        bench.section(flaky)
+        recs = _lines(capsys)
+        assert [r["metric"] for r in recs] == ["m", "late"]
+        assert recs[0]["value"] == 1   # the RETRY's value, not the first
+        assert len(calls) == 2
+
+    def test_double_failure_raises_after_flushing(self, capsys):
+        def broken():
+            bench.emit("partial", 1, "u", 1.0)
+            raise RuntimeError("real failure")
+        with pytest.raises(RuntimeError):
+            bench.section(broken)
+        # partial metrics of the final attempt still flushed, and the
+        # buffer is reset for the next section
+        assert [r["metric"] for r in _lines(capsys)] == ["partial"]
+        assert bench._METRIC_BUFFER is None
