@@ -442,6 +442,21 @@ def status(registry) -> Dict[str, Any]:
         info["platform"] = f"error: {e}"
     info["status"] = ("(sleeping)" if info["storage"] == "ok"
                       else "storage check failed")
+    # the latest completed train with its per-phase timings (the
+    # tracing record run_train persists into runtime_conf)
+    try:
+        latest = registry.get_meta_data_engine_instances() \
+            .get_latest_completed("default", "default", "default")
+        if latest is not None:
+            info["latestTrainedInstance"] = {
+                "id": latest.id,
+                "startTime": format_time(latest.start_time),
+                "endTime": format_time(latest.end_time),
+                "phaseTimings": latest.runtime_conf.get(
+                    "phase_timings", {}),
+            }
+    except Exception:   # status must never fail on metadata quirks
+        pass
     return info
 
 

@@ -147,6 +147,13 @@ class TestTrainBatchPredict:
         ej.write_text(json.dumps(variant))
         result = ops.train(mem_registry, engine_json=str(ej))
         assert result["status"] == "COMPLETED"
+        assert result["phaseTimings"].keys() >= {"read_s", "prepare_s",
+                                                 "train_algo0_s"}
+        # status surfaces the latest train's per-phase tracing record
+        info = ops.status(mem_registry)
+        latest = info["latestTrainedInstance"]
+        assert latest["id"] == result["engineInstanceId"]
+        assert "train_algo0_s" in latest["phaseTimings"]
         qfile = tmp_path / "queries.jsonl"
         qfile.write_text("\n".join(
             json.dumps({"user": f"u{u}", "num": 3}) for u in range(5)))
