@@ -8,6 +8,7 @@ drivers, and the REST Event Server.
 
 from predictionio_tpu.data.event import Event, DataMap, EventValidation, PropertyMap
 from predictionio_tpu.data.aggregate import EventOp, aggregate_properties
+from predictionio_tpu.data.view import DataView
 
 __all__ = [
     "Event",
@@ -16,4 +17,5 @@ __all__ = [
     "PropertyMap",
     "EventOp",
     "aggregate_properties",
+    "DataView",
 ]
