@@ -151,6 +151,12 @@ class _SegmentIndex:
         self.tbloom = bytearray(bits // 8)
         self.tfilled = 0
         self.event_names: Set[str] = set()   # exact: low cardinality
+        # True while event_names is known NOT to cover every frame (a
+        # legacy sidecar loaded without an 'events' key, then appended
+        # to): pruning must be disabled and the partial set must never
+        # be persisted, or queries naming only pre-upgrade events would
+        # silently skip this segment
+        self.names_incomplete = False
         self.dirty = 0           # appends since last persist
         self.mem_size = 0        # journal bytes the in-memory state covers
 
@@ -189,9 +195,9 @@ class _SegmentIndex:
         return self._bits_contain(self.tbloom, tet, tei)
 
     def may_contain_event(self, names) -> bool:
-        # empty set = a legacy sidecar that never recorded names: no
-        # pruning evidence, must scan
-        if not self.event_names:
+        # empty or incomplete set = a legacy sidecar that never (fully)
+        # recorded names: no pruning evidence, must scan
+        if self.names_incomplete or not self.event_names:
             return True
         return any(n in self.event_names for n in names)
 
@@ -211,7 +217,10 @@ class _SegmentIndex:
         ix.min_us, ix.max_us = self.min_us, self.max_us
         ix.count, ix.synced = self.count, self.synced
         ix.mem_size, ix.dirty = self.mem_size, self.dirty
-        ix.event_names = set(self.event_names)
+        # `events` is the full segment: rebuild the name set from it, so
+        # a names_incomplete legacy index heals here instead of carrying
+        # the flag forward
+        ix.event_names = {ev.event for ev in events}
         for ev in events:
             ix._bloom_add(ev.entity_type, ev.entity_id)
             if ev.target_entity_type and ev.target_entity_id:
@@ -230,12 +239,17 @@ class _SegmentIndex:
         return True
 
     def dump(self) -> dict:
-        return {"min_us": self.min_us, "max_us": self.max_us,
-                "count": self.count, "synced": self.synced,
-                "bits": self.bits,
-                "bloom": b64encode(bytes(self.bloom)).decode(),
-                "tbloom": b64encode(bytes(self.tbloom)).decode(),
-                "events": sorted(self.event_names)}
+        out = {"min_us": self.min_us, "max_us": self.max_us,
+               "count": self.count, "synced": self.synced,
+               "bits": self.bits,
+               "bloom": b64encode(bytes(self.bloom)).decode(),
+               "tbloom": b64encode(bytes(self.tbloom)).decode()}
+        # an incomplete name set must not be persisted as if exhaustive:
+        # omitting the key keeps the sidecar in legacy (never-prune)
+        # form until a full rebuild supplies a complete set
+        if not self.names_incomplete:
+            out["events"] = sorted(self.event_names)
+        return out
 
     @classmethod
     def load(cls, obj: dict) -> "_SegmentIndex":
@@ -254,6 +268,10 @@ class _SegmentIndex:
         ix.tfilled = int.from_bytes(bytes(ix.tbloom),
                                     "little").bit_count()
         ix.event_names = set(obj.get("events", ()))
+        # a legacy sidecar (pre-'events') covers frames whose names were
+        # never recorded: appends may NOT flip the set to "non-empty and
+        # trusted" — that would prune queries naming only legacy events
+        ix.names_incomplete = "events" not in obj
         return ix
 
 
@@ -441,29 +459,44 @@ class PevlogEvents(base.EventStore):
         entries for every frame living outside its id's prefix bucket
         before the marker appears — atomically (tmp + rename), so a
         crash mid-backfill doesn't leave a marker that hides data."""
+        import fcntl
         path = part / "external_ids.log"
-        with self.c.lock:   # serialize vs concurrent inserts: a racing
-            # backfill's rename must never clobber frames another
-            # thread just appended to the freshly created log
-            if path.exists():
-                return
-            frames = []
-            for seg in self._segments(part):
-                seg_bucket = int(seg.name[4:20], 16)
-                for eid in self._replay_segment(seg):
-                    if self._bucket_from_id(eid) != seg_bucket:
-                        frames.append(json.dumps(
-                            {"x": eid, "b": seg_bucket}).encode())
-            tmp = part / "external_ids.log.tmp"
-            if tmp.exists():
-                tmp.unlink()
-            if frames:
-                EventLog(str(tmp)).append_many(frames)
-            else:
-                tmp.touch()
-            tmp.replace(path)
-            # the file identity changed: any cached scan state is stale
-            self.c.replay_cache.pop(str(path), None)
+        if path.exists():      # cheap no-lock fast path: the marker is
+            return             # never removed once present
+        with self.c.lock:   # serialize vs concurrent inserts in THIS
+            # process; the flock below extends the exclusion across
+            # processes — journal appends are flock'd per-frame, so two
+            # processes first-touching a legacy partition could
+            # otherwise interleave check/backfill/rename and the loser's
+            # rename would clobber frames the winner just appended.
+            # The lock file lives OUTSIDE the partition dir: remove()
+            # unlinks everything inside it, and an unlinked lock file
+            # would let a later process flock a fresh inode concurrently
+            # with a holder of the old one
+            lockf = (part.parent / f"{part.name}.lock").open("a")
+            try:
+                fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+                if path.exists():
+                    return
+                frames = []
+                for seg in self._segments(part):
+                    seg_bucket = int(seg.name[4:20], 16)
+                    for eid in self._replay_segment(seg):
+                        if self._bucket_from_id(eid) != seg_bucket:
+                            frames.append(json.dumps(
+                                {"x": eid, "b": seg_bucket}).encode())
+                tmp = part / "external_ids.log.tmp"
+                if tmp.exists():
+                    tmp.unlink()
+                if frames:
+                    EventLog(str(tmp)).append_many(frames)
+                else:
+                    tmp.touch()
+                tmp.replace(path)
+                # file identity changed: any cached scan state is stale
+                self.c.replay_cache.pop(str(path), None)
+            finally:
+                lockf.close()   # releases the flock
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         part = self._part_dir(app_id, channel_id)

@@ -757,7 +757,11 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
     operands in the rank > 16 paired path (normal-equation accumulation
     and the CG solve are always f32) — bf16 is the TPU-first default and
     is gated by the bench's RMSE-parity check; rank <= 16 and the
-    reference `_solve_bucket` path are exact f32 regardless. `cg_iters`
+    reference `_solve_bucket` path are exact f32 regardless. Rating
+    VALUES additionally cross the link in bf16 on that path, but only
+    when every rating round-trips bfloat16 exactly (half-star ratings
+    do); otherwise values stay f32, so no rating is ever silently
+    rounded. `cg_iters`
     caps the warm-started CG (see _CG_ITERS).
 
     Conditioning note (MLlib parity): MLlib's CholeskySolver is exact
@@ -827,14 +831,18 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
         return out
 
     # transfer-lean upload: ragged entries only, uint16 idx when the
-    # opposite side fits, bf16 values on the EXPLICIT paired hot path
-    # (exact for half-star ratings; the f32 escape hatch is
-    # precision="f32"). Implicit mode keeps f32 values: confidences
-    # c = alpha*|r| are computed in f32 from the raw ratings, and
-    # count-valued ratings above 256 would silently round in bf16.
+    # opposite side fits, bf16 values on the EXPLICIT paired hot path —
+    # but ONLY when every rating round-trips bfloat16 exactly (half-star
+    # ratings do; arbitrary scores like 4.7 do not, and silently
+    # rounding them in the normal equations is a behavior change the
+    # caller never asked for). Non-exact values fall back to f32
+    # transfer. Implicit mode keeps f32 values: confidences c = alpha*|r|
+    # are computed in f32 from the raw ratings, and count-valued ratings
+    # above 256 would round in bf16.
     paired = rank > _SMALL_RANK
     val_dt = (jnp.bfloat16
-              if (paired and cast is jnp.bfloat16 and not implicit)
+              if (paired and cast is jnp.bfloat16 and not implicit
+                  and _bf16_exact(user_side.val))
               else np.float32)
     dev_sides = [device_slabs(user_side, n_items, val_dt),
                  device_slabs(item_side, n_users, val_dt)]
@@ -854,6 +862,23 @@ def als_train(ratings: "RatingColumns | Tuple[np.ndarray, np.ndarray, np.ndarray
                        solve_s=t_solve - t_xfer,
                        fetch_s=_time.perf_counter() - t_solve)
     return out
+
+
+def _bf16_exact(arrays) -> bool:
+    """True iff every value in the per-bucket arrays round-trips
+    bfloat16 exactly (host-side, chunked: no values-sized temporary).
+    Guards the bf16 value transfer in `als_train` — ratings that bf16
+    cannot represent (4.7, percentages) must cross in f32."""
+    import jax.numpy as jnp
+    step = 1 << 22
+    for a in arrays:
+        a = np.asarray(a)
+        for s in range(0, len(a), step):
+            c = np.asarray(a[s:s + step], np.float32)
+            if not np.array_equal(
+                    c, c.astype(jnp.bfloat16).astype(np.float32)):
+                return False
+    return True
 
 
 def _check_residual(res: float, timings: Optional[dict]) -> None:

@@ -71,10 +71,11 @@ def nb_train(features: np.ndarray, labels: np.ndarray,
     two segment-sums of sufficient statistics, so GSPMD turns the
     sharded inputs into per-device partial sums + an all-reduce (padding
     rows carry valid=0 and vanish from every statistic)."""
-    if (features < 0).any():
-        raise ValueError("multinomial NB requires nonnegative features")
     if features.shape[0] == 0:
         raise ValueError("no training points")
+    fmin = float(np.asarray(features).min(initial=0.0))
+    if fmin < 0:
+        raise ValueError("multinomial NB requires nonnegative features")
     uniq = np.unique(labels)
     class_ix = np.searchsorted(uniq, labels).astype(np.int32)
     valid = np.ones(len(labels), np.float32)
@@ -84,7 +85,11 @@ def nb_train(features: np.ndarray, labels: np.ndarray,
     # multinomial NB regime) are EXACT in bfloat16: cross the
     # host->device link at half the bytes and widen device-side
     # (accumulation is f32 either way, so the statistics are identical)
-    if feats_np.max(initial=0.0) < 256 and _integer_valued(src):
+    # gate on BOTH bounds: 0 <= x < 256 integers are exact in bf16; the
+    # min is already checked loudly above (fmin >= 0 here), restated in
+    # the gate so the bf16 choice never outlives that validation
+    if 0 <= fmin and feats_np.max(initial=0.0) < 256 \
+            and _integer_valued(src):
         feats_np = feats_np.astype(jnp.bfloat16)
     if mesh is not None:
         from predictionio_tpu.parallel import shard_put

@@ -303,6 +303,25 @@ class TestPairedSolver:
         np.testing.assert_allclose(x @ y.T, xo @ yo.T, rtol=0.1,
                                    atol=0.1)
 
+    def test_bf16_value_transfer_gated_on_exactness(self):
+        # half-star ratings round-trip bf16; arbitrary scores (4.7) do
+        # not and must NOT be silently rounded by the value upload
+        import numpy as np
+        assert als._bf16_exact([np.array([0.5, 3.0, 4.5], np.float32)])
+        assert not als._bf16_exact([np.array([4.7], np.float32)])
+        # end-to-end: non-exact explicit values at rank>16 still match
+        # the f32 oracle (values crossed in f32, not rounded bf16)
+        u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=11)
+        val = val + np.float32(0.07)     # not bf16-representable
+        x, y = als.als_train((u_ix, i_ix, val), 60, 40, rank=24,
+                             iterations=6, reg=0.05, seed=2)
+        x0, y0 = als.init_factors(60, 40, 24, 2)
+        xo, yo = oracle.als_train(u_ix, i_ix, val, 60, 40, rank=24,
+                                  iterations=6, reg=0.05, x0=x0, y0=y0)
+        ours = als.rmse(x, y, u_ix, i_ix, val)
+        ref = oracle.rmse(xo, yo, u_ix, i_ix, val)
+        assert abs(ours - ref) < 2e-2, (ours, ref)
+
     def test_solver_residual_surfaced(self):
         u_ix, i_ix, val = synthetic(60, 40, 4, density=0.4, seed=8)
         tm = {}

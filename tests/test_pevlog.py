@@ -98,6 +98,50 @@ class TestPruning:
         assert out == []    # matches nothing, but was scanned not pruned
         assert ev2.c.stats["segments_scanned"] >= 2
 
+    def test_legacy_sidecar_appends_never_poison_name_pruning(
+            self, store, tmp_path):
+        # upgrade bug regression: a legacy sidecar (no 'events' key)
+        # loads with an empty name set; an append then makes the set
+        # non-empty but INCOMPLETE — it must not become pruning evidence
+        # (queries naming only pre-upgrade events would silently drop),
+        # and the partial set must not be persisted as if exhaustive
+        import json as _json
+        store.insert_batch([_mk(0, "u0", name="view")], 1)
+        store.close()
+        [idx] = tmp_path.glob("app_1/seg_*.idx")
+        obj = _json.loads(idx.read_text())
+        del obj["events"]
+        idx.write_text(_json.dumps(obj))
+        ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        ev2.insert_batch([_mk(0, "u1", name="buy")], 1)
+        assert [e.entity_id for e in ev2.find(1, event_names=["view"])] \
+            == ["u0"]
+        ev2.close()   # persists the sidecar: partial set must be omitted
+        obj = _json.loads(idx.read_text())
+        assert "events" not in obj or set(obj["events"]) >= {"view", "buy"}
+        ev3 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        assert [e.entity_id for e in ev3.find(1, event_names=["view"])] \
+            == ["u0"]
+
+    def test_legacy_sidecar_heals_on_bloom_growth(self, store, tmp_path):
+        # with_grown_bloom replays the full segment: the rebuilt index
+        # has a complete name set and may prune again
+        import json as _json
+        from predictionio_tpu.data.storage.pevlog import _SegmentIndex
+        store.insert_batch([_mk(0, "u0", name="view")], 1)
+        store.close()
+        [idx] = tmp_path.glob("app_1/seg_*.idx")
+        obj = _json.loads(idx.read_text())
+        del obj["events"]
+        legacy = _SegmentIndex.load(obj)
+        assert legacy.names_incomplete
+        healed = legacy.with_grown_bloom([_mk(0, "u0", name="view")])
+        assert not healed.names_incomplete
+        assert healed.event_names == {"view"}
+        assert not healed.may_contain_event(["buy"])
+
     def test_full_scan_still_correct(self, store):
         store.insert_batch(
             [_mk(d, f"u{d % 3}") for d in range(10)], 1)
