@@ -359,38 +359,48 @@ class EventValidation:
 
     @classmethod
     def validate(cls, e: Event) -> None:
-        def require(cond: bool, msg: str) -> None:
-            if not cond:
-                raise ValueError(msg)
-
-        require(bool(e.event), "event must not be empty.")
-        require(bool(e.entity_type), "entityType must not be empty string.")
-        require(bool(e.entity_id), "entityId must not be empty string.")
-        require(e.target_entity_type is None or bool(e.target_entity_type),
-                "targetEntityType must not be empty string")
-        require(e.target_entity_id is None or bool(e.target_entity_id),
-                "targetEntityId must not be empty string.")
-        require(not (e.target_entity_type is not None and e.target_entity_id is None),
-                "targetEntityType and targetEntityId must be specified together.")
-        require(not (e.target_entity_type is None and e.target_entity_id is not None),
-                "targetEntityType and targetEntityId must be specified together.")
-        require(not (e.event == "$unset" and e.properties.is_empty),
-                "properties cannot be empty for $unset event")
-        require(not cls.is_reserved_prefix(e.event) or cls.is_special_event(e.event),
-                f"{e.event} is not a supported reserved event name.")
-        require(not cls.is_special_event(e.event)
-                or (e.target_entity_type is None and e.target_entity_id is None),
-                f"Reserved event {e.event} cannot have targetEntity")
-        require(not cls.is_reserved_prefix(e.entity_type)
-                or cls.is_builtin_entity_type(e.entity_type),
+        # plain if-chains, no per-call closure and no eager f-string
+        # formatting: this runs once per event on the bulk-ingest hot
+        # path (millions of calls), where the closure + message
+        # construction were a measured double-digit % of wall-clock
+        if not e.event:
+            raise ValueError("event must not be empty.")
+        if not e.entity_type:
+            raise ValueError("entityType must not be empty string.")
+        if not e.entity_id:
+            raise ValueError("entityId must not be empty string.")
+        tet, tei = e.target_entity_type, e.target_entity_id
+        if tet is not None or tei is not None:
+            if tet == "":
+                raise ValueError("targetEntityType must not be empty string")
+            if tei == "":
+                raise ValueError("targetEntityId must not be empty string.")
+            if tet is None or tei is None:
+                raise ValueError("targetEntityType and targetEntityId "
+                                 "must be specified together.")
+        ev0 = e.event[0]
+        if ev0 == "$" or e.event.startswith("pio_"):
+            if not cls.is_special_event(e.event):
+                raise ValueError(
+                    f"{e.event} is not a supported reserved event name.")
+            if e.event == "$unset" and e.properties.is_empty:
+                raise ValueError(
+                    "properties cannot be empty for $unset event")
+            if tet is not None or tei is not None:
+                raise ValueError(
+                    f"Reserved event {e.event} cannot have targetEntity")
+        if (e.entity_type[0] == "$" or e.entity_type.startswith("pio_")) \
+                and not cls.is_builtin_entity_type(e.entity_type):
+            raise ValueError(
                 f"The entityType {e.entity_type} is not allowed. "
                 "'pio_' is a reserved name prefix.")
-        require(e.target_entity_type is None
-                or not cls.is_reserved_prefix(e.target_entity_type)
-                or cls.is_builtin_entity_type(e.target_entity_type),
-                f"The targetEntityType {e.target_entity_type} is not allowed. "
+        if tet is not None and cls.is_reserved_prefix(tet) \
+                and not cls.is_builtin_entity_type(tet):
+            raise ValueError(
+                f"The targetEntityType {tet} is not allowed. "
                 "'pio_' is a reserved name prefix.")
-        cls.validate_properties(e)
+        if not e.properties.is_empty:
+            cls.validate_properties(e)
 
     @classmethod
     def validate_properties(cls, e: Event) -> None:

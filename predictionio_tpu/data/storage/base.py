@@ -293,6 +293,16 @@ class Models(abc.ABC):
 _UNSET = object()  # sentinel distinguishing "no filter" from "filter == None"
 
 
+def match_properties(e: Event, properties: Dict[str, object]) -> bool:
+    """True iff every (name, value) filter pair appears verbatim in the
+    event's properties (the ES field-value query role)."""
+    fields = e.properties.fields
+    for k, v in properties.items():
+        if k not in fields or fields[k] != v:
+            return False
+    return True
+
+
 class EventStore(abc.ABC):
     """Event DAO, the analog of the reference's `LEvents` trait
     (LEvents.scala:40-520). All operations are scoped to an (app, channel);
@@ -357,12 +367,21 @@ class EventStore(abc.ABC):
              event_names: Optional[Sequence[str]] = None,
              target_entity_type: object = _UNSET,
              target_entity_id: object = _UNSET,
+             properties: Optional[Dict[str, object]] = None,
              limit: Optional[int] = None,
              reversed: bool = False) -> Iterator[Event]:
         """Find events; limit None = unlimited, limit <= 0 = unlimited
         (LEvents futureFind; the API layer applies its own default of 20).
         reversed=True requires entity_type+entity_id in the API layer; the
-        store just sorts descending by event time."""
+        store just sorts descending by event time.
+
+        `properties` filters on exact property values: an event matches
+        when every (name, value) pair appears in its properties — the
+        arbitrary field-value query the reference delegates to
+        Elasticsearch's query DSL (ESLEvents.scala:308). Every driver
+        supports it (post-filter); PEVLOG additionally pushes it down to
+        a per-segment property Bloom so non-matching segments are never
+        replayed."""
 
     # -- derived operations --------------------------------------------------
     def aggregate_properties(self, app_id: int,
@@ -407,8 +426,11 @@ def match_event(e: Event, *,
                 entity_id: Optional[str] = None,
                 event_names: Optional[Sequence[str]] = None,
                 target_entity_type: object = _UNSET,
-                target_entity_id: object = _UNSET) -> bool:
+                target_entity_id: object = _UNSET,
+                properties: Optional[Dict[str, object]] = None) -> bool:
     """Shared in-memory filter predicate implementing `find` semantics."""
+    if properties and not match_properties(e, properties):
+        return False
     if start_time is not None and e.event_time < _aware(start_time):
         return False
     if until_time is not None and e.event_time >= _aware(until_time):

@@ -137,6 +137,7 @@ class EvlogEvents(base.EventStore):
              entity_id=None, event_names=None,
              target_entity_type=base._UNSET,
              target_entity_id=base._UNSET,
+             properties=None,
              limit: Optional[int] = None,
              reversed: bool = False) -> Iterator[Event]:
         events = [
@@ -146,7 +147,8 @@ class EvlogEvents(base.EventStore):
                 entity_type=entity_type, entity_id=entity_id,
                 event_names=event_names,
                 target_entity_type=target_entity_type,
-                target_entity_id=target_entity_id)]
+                target_entity_id=target_entity_id,
+                properties=properties)]
         events.sort(key=lambda e: e.event_time, reverse=reversed)
         if limit is not None and limit > 0:
             events = events[:limit]

@@ -268,6 +268,7 @@ class MemEvents(base.EventStore):
              event_names: Optional[Sequence[str]] = None,
              target_entity_type: object = _UNSET,
              target_entity_id: object = _UNSET,
+             properties=None,
              limit: Optional[int] = None,
              reversed: bool = False) -> Iterator[Event]:
         with self.c.lock:
@@ -276,7 +277,7 @@ class MemEvents(base.EventStore):
             e, start_time=start_time, until_time=until_time,
             entity_type=entity_type, entity_id=entity_id,
             event_names=event_names, target_entity_type=target_entity_type,
-            target_entity_id=target_entity_id)]
+            target_entity_id=target_entity_id, properties=properties)]
         events.sort(key=lambda e: (e.event_time_millis, e.event_id or ""),
                     reverse=reversed)
         if limit is not None and limit > 0:

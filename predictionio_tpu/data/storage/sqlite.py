@@ -25,6 +25,7 @@ from predictionio_tpu.data.event import (
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model, _UNSET,
+    match_properties as _match_properties,
 )
 
 
@@ -519,6 +520,7 @@ class SQLiteEvents(base.EventStore):
              event_names: Optional[Sequence[str]] = None,
              target_entity_type: object = _UNSET,
              target_entity_id: object = _UNSET,
+             properties=None,
              limit: Optional[int] = None,
              reversed: bool = False) -> Iterator[Event]:
         t = event_table_name(app_id, channel_id)
@@ -556,8 +558,16 @@ class SQLiteEvents(base.EventStore):
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
         order = " ORDER BY eventtime DESC, id DESC" if reversed \
             else " ORDER BY eventtime ASC, id ASC"
-        lim = f" LIMIT {int(limit)}" if limit is not None and limit > 0 else ""
+        # a property filter is applied post-SQL (the properties column is
+        # a JSON blob), so the LIMIT must move after it
+        lim = f" LIMIT {int(limit)}" \
+            if limit is not None and limit > 0 and not properties else ""
         with self.c.lock:
             rows = self.c.conn.execute(
                 f"SELECT * FROM {t}{where}{order}{lim}", params).fetchall()
-        return iter([self._row_to_event(r) for r in rows])
+        events = [self._row_to_event(r) for r in rows]
+        if properties:
+            events = [e for e in events if _match_properties(e, properties)]
+            if limit is not None and limit > 0:
+                events = events[:limit]
+        return iter(events)

@@ -77,9 +77,12 @@ class EventLog:
             size = Path(self.path).stat().st_size if \
                 Path(self.path).exists() else 0
             return size, size
-        blob = b"".join(
-            _HEADER.pack(MAGIC, len(p), zlib.crc32(p) & 0xFFFFFFFF) + p
-            for p in payloads)
+        parts = []
+        pack, crc = _HEADER.pack, zlib.crc32
+        for p in payloads:
+            parts.append(pack(MAGIC, len(p), crc(p) & 0xFFFFFFFF))
+            parts.append(p)
+        blob = b"".join(parts)
         if self._lib is not None:
             if self._has_blob:
                 off = self._lib.el_append_blob(self.path.encode(), blob,

@@ -28,6 +28,22 @@ def _mk(day: int, user: str, name: str = "view") -> Event:
                  properties=DataMap({}), event_time=T0 + timedelta(days=day))
 
 
+def _to_legacy(obj: dict, drop=()) -> dict:
+    """Convert a current (compressed-key) sidecar dict to the historical
+    raw format, minus `drop`ped keys — simulating sidecars written by
+    older versions."""
+    import zlib
+    from base64 import b64decode, b64encode
+    out = dict(obj)
+    for zk, k in (("zbloom", "bloom"), ("ztbloom", "tbloom"),
+                  ("zpbloom", "pbloom")):
+        if zk in out:
+            out[k] = b64encode(zlib.decompress(b64decode(out.pop(zk)))).decode()
+    for k in drop:
+        out.pop(k, None)
+    return out
+
+
 class TestPruning:
     def test_time_range_scans_only_overlapping_segments(self, store):
         # 30 daily buckets, 4 events each
@@ -86,8 +102,8 @@ class TestPruning:
         store.insert_batch([_mk(0, "u0", name="buy")], 1)
         store.close()
         [idx] = tmp_path.glob("app_1/seg_*.idx")
-        obj = _json.loads(idx.read_text())
-        del obj["events"], obj["tbloom"]
+        obj = _to_legacy(_json.loads(idx.read_text()),
+                         drop=("events", "tbloom", "pbloom"))
         idx.write_text(_json.dumps(obj))
         ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
                                                 "BUCKET_HOURS": 24}))
@@ -97,6 +113,93 @@ class TestPruning:
                             target_entity_id="x"))
         assert out == []    # matches nothing, but was scanned not pruned
         assert ev2.c.stats["segments_scanned"] >= 2
+
+    def test_property_value_prunes_segments(self, store):
+        # the ES query-DSL pushdown (ESLEvents.scala:308): a property-
+        # value find must scan FEWER segments than a time-unbounded scan
+        # — only the segment whose property Bloom may contain the pair
+        from predictionio_tpu.data import DataMap, Event
+        evs = [_mk(d, f"u{d}") for d in range(20)]
+        evs.append(Event(
+            event="$set", entity_type="item", entity_id="i1",
+            properties=DataMap({"category": "books"}),
+            event_time=T0 + timedelta(days=7)))
+        store.insert_batch(evs, 1)
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        out = list(store.find(1, properties={"category": "books"}))
+        assert [e.entity_id for e in out] == ["i1"]
+        assert store.c.stats["segments_scanned"] <= 2  # bloom fp slack
+        assert store.c.stats["segments_pruned"] >= 18
+        # a pair that exists nowhere prunes everything
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        assert list(store.find(1, properties={"category": "absent"})) == []
+        assert store.c.stats["segments_scanned"] <= 1
+
+    def test_control_characters_in_strings_survive_roundtrip(self, store):
+        # regression: the fast JSON literal path must not embed raw
+        # control characters (a '$'-anchored regex matched before a
+        # trailing newline, corrupting the segment forever)
+        from predictionio_tpu.data import DataMap, Event
+        tricky = ["u1\n", "a\tb", 'say "hi"', "back\\slash", "плюс"]
+        ids = store.insert_batch(
+            [Event(event="view", entity_type="user", entity_id=s,
+                   properties=DataMap({}), event_time=T0)
+             for s in tricky], 1)
+        got = sorted(e.entity_id for e in store.find(1))
+        assert got == sorted(tricky)
+        # fresh client: the on-disk frames decode too
+        ev2 = PevlogEvents(PevlogStorageClient(
+            {"PATH": str(store.c.base_dir), "BUCKET_HOURS": 24}))
+        assert sorted(e.entity_id for e in ev2.find(1)) == sorted(tricky)
+        assert ev2.get(ids[0], 1).entity_id == "u1\n"
+
+    def test_property_filter_numeric_type_insensitive(self, store):
+        # regression: 10 == 10.0 == True's 1 under the post-filter's ==,
+        # so the Bloom key must not distinguish them (a typed key falsely
+        # PRUNED the matching segment on this driver only)
+        from predictionio_tpu.data import DataMap, Event
+        store.insert_batch([Event(
+            event="$set", entity_type="item", entity_id="i1",
+            properties=DataMap({"price": 10, "flag": True,
+                                "mix": [1, 2.5]}),
+            event_time=T0)], 1)
+        assert [e.entity_id for e in store.find(
+            1, properties={"price": 10.0})] == ["i1"]
+        assert [e.entity_id for e in store.find(
+            1, properties={"flag": 1})] == ["i1"]
+        assert [e.entity_id for e in store.find(
+            1, properties={"mix": [1.0, 2.5]})] == ["i1"]
+
+    def test_property_pruning_survives_sidecar_roundtrip(
+            self, store, tmp_path):
+        from predictionio_tpu.data import DataMap, Event
+        store.insert_batch([
+            _mk(0, "u0"),
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"k": [1, {"a": 2}]}),
+                  event_time=T0 + timedelta(days=3))], 1)
+        store.close()
+        ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        out = list(ev2.find(1, properties={"k": [1, {"a": 2}]}))
+        assert [e.entity_id for e in out] == ["i1"]
+
+    def test_pre_property_sidecar_never_prunes_then_heals(
+            self, store, tmp_path):
+        # sidecars written before the property Bloom existed must scan
+        import json as _json
+        from predictionio_tpu.data import DataMap, Event
+        store.insert_batch([Event(
+            event="$set", entity_type="item", entity_id="i1",
+            properties=DataMap({"c": "x"}), event_time=T0)], 1)
+        store.close()
+        [idx] = tmp_path.glob("app_1/seg_*.idx")
+        obj = _to_legacy(_json.loads(idx.read_text()), drop=("pbloom",))
+        idx.write_text(_json.dumps(obj))
+        ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        out = list(ev2.find(1, properties={"c": "x"}))
+        assert [e.entity_id for e in out] == ["i1"]
 
     def test_legacy_sidecar_appends_never_poison_name_pruning(
             self, store, tmp_path):
@@ -109,8 +212,7 @@ class TestPruning:
         store.insert_batch([_mk(0, "u0", name="view")], 1)
         store.close()
         [idx] = tmp_path.glob("app_1/seg_*.idx")
-        obj = _json.loads(idx.read_text())
-        del obj["events"]
+        obj = _to_legacy(_json.loads(idx.read_text()), drop=("events",))
         idx.write_text(_json.dumps(obj))
         ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
                                                 "BUCKET_HOURS": 24}))
@@ -133,8 +235,7 @@ class TestPruning:
         store.insert_batch([_mk(0, "u0", name="view")], 1)
         store.close()
         [idx] = tmp_path.glob("app_1/seg_*.idx")
-        obj = _json.loads(idx.read_text())
-        del obj["events"]
+        obj = _to_legacy(_json.loads(idx.read_text()), drop=("events",))
         legacy = _SegmentIndex.load(obj)
         assert legacy.names_incomplete
         healed = legacy.with_grown_bloom([_mk(0, "u0", name="view")])

@@ -158,6 +158,31 @@ class TestEventStoreContract:
         assert es.get(eid, 1) is None
         assert es.delete(eid, 1) is False
 
+    def test_find_by_property_values(self, registry):
+        # the ES query-DSL role (ESLEvents.scala:308): exact
+        # property-value filtering, supported by every driver
+        es = registry.get_events()
+        es.init(3)
+        es.insert(ev(event="$set", eid="i1", etype="item",
+                     props={"category": "books", "price": 10}), 3)
+        es.insert(ev(event="$set", eid="i2", etype="item",
+                     props={"category": "tools", "price": 10}), 3)
+        es.insert(ev(event="view", eid="u1", t=5), 3)
+        got = [e.entity_id for e in es.find(
+            3, properties={"category": "books"})]
+        assert got == ["i1"]
+        got = [e.entity_id for e in es.find(3, properties={"price": 10})]
+        assert sorted(got) == ["i1", "i2"]
+        # all pairs must match
+        got = [e.entity_id for e in es.find(
+            3, properties={"category": "tools", "price": 10})]
+        assert got == ["i2"]
+        assert list(es.find(3, properties={"category": "missing"})) == []
+        # composes with the other filters and with limit
+        got = [e.entity_id for e in es.find(
+            3, event_names=["$set"], properties={"price": 10}, limit=1)]
+        assert len(got) == 1
+
     def test_channel_isolation(self, registry):
         es = registry.get_events()
         es.init(1)
