@@ -178,6 +178,9 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("run", help="run a dotted-path function with storage "
                                    "configured (console run analog)")
     x.add_argument("target", help="module.function")
+    sub.add_parser("shell",
+                   help="interactive Python with the storage registry "
+                        "and core API preloaded (bin/pio-shell analog)")
     x = sub.add_parser("template",
                        help="scaffold a new engine directory "
                             "(commands/Template.scala analog)")
@@ -367,6 +370,24 @@ def main(argv: Optional[list] = None) -> int:
             result = fn()
             if result is not None:
                 _emit(result)
+            return 0
+        if cmd == "shell":
+            # bin/pio-shell analog: a REPL with the storage registry
+            # and core API in scope (the reference drops users into a
+            # spark-shell with pio jars on the classpath)
+            import code
+
+            import predictionio_tpu
+            from predictionio_tpu import core, data, models, ops as tops
+            registry = _registry()
+            ns = {"predictionio_tpu": predictionio_tpu, "core": core,
+                  "data": data, "models": models, "ops": tops,
+                  "registry": registry,
+                  "events": registry.get_events()}
+            banner = ("pio-tpu shell - preloaded: registry (storage "
+                      "registry), events (event store), core, data, "
+                      "models, ops")
+            code.interact(banner=banner, local=ns)
             return 0
     except (ValueError, OSError) as e:
         print(f"[ERROR] {e}", file=sys.stderr)

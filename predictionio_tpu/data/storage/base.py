@@ -295,10 +295,12 @@ _UNSET = object()  # sentinel distinguishing "no filter" from "filter == None"
 
 def match_properties(e: Event, properties: Dict[str, object]) -> bool:
     """True iff every (name, value) filter pair appears verbatim in the
-    event's properties (the ES field-value query role)."""
-    fields = e.properties.fields
+    event's properties (the ES field-value query role). Uses the
+    PropertyMap's own `in`/`[]` — `.fields` copies the dict, which adds
+    up on the per-event post-filter path."""
+    pm = e.properties
     for k, v in properties.items():
-        if k not in fields or fields[k] != v:
+        if k not in pm or pm[k] != v:
             return False
     return True
 

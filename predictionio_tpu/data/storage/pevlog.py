@@ -161,13 +161,14 @@ _BLOOM_HASHES = 4
 _BLOOM_MAX_FILL = 3
 # ~16 bits per expected entity keeps fill ~ 0.22 after sizing
 _BLOOM_BITS_PER_ENTITY = 16
-# appends between index persists. The sidecar is a pure cache (a crash
-# rebuilds it from the journal, incrementally), so the flush cadence
-# trades a bounded rebuild window for ingest throughput: persisting
-# every few hundred appends re-serialized megabyte Blooms once per
-# bulk batch per segment — measured as a real slice of 10M-event
-# ingest.
-_IDX_FLUSH_EVERY = 20_000
+# sidecar persist cadence: flush when at least this many appends AND at
+# least 1/_IDX_FLUSH_FRACTION of the segment is unpersisted. The
+# proportional rule bounds a cold reader's catch-up work (the stale
+# tail `_extend_index` decodes) to ~12% of any segment while keeping the
+# persist count per segment O(log growth); the absolute floor keeps
+# singleton-insert workloads from persisting every event.
+_IDX_FLUSH_MIN = 1024
+_IDX_FLUSH_FRACTION = 8
 
 
 def _bloom_bits_for(n: int) -> int:
@@ -577,9 +578,11 @@ class PevlogEvents(base.EventStore):
     # -- index ---------------------------------------------------------------
     def _index(self, seg: Path) -> _SegmentIndex:
         """In-memory index if it covers the journal exactly; else the
-        persisted sidecar if IT does; else rebuild from the journal
-        (source of truth — covers crashes mid-flush and appends by other
-        processes)."""
+        persisted sidecar — EXTENDED over the journal's append-only tail
+        when it covers a prefix (`_extend_index`: a cold reader after a
+        crash or an unflushed writer decodes only the few-% stale tail,
+        never the whole segment); else rebuild from the journal (source
+        of truth — covers shrunk journals and corrupt sidecars)."""
         key = str(seg)
         size = seg.stat().st_size if seg.exists() else 0
         ix = self.c.index_cache.get(key)
@@ -592,7 +595,11 @@ class PevlogEvents(base.EventStore):
                 ix = _SegmentIndex.load(json.loads(idx_path.read_text()))
             except (ValueError, KeyError):
                 ix = None
-        if ix is None or ix.synced != size:
+        if ix is not None and ix.synced == size:
+            ix.mem_size = ix.synced
+        elif ix is not None and 0 < ix.synced < size:
+            self._extend_index(seg, ix, size)
+        else:
             table = self._replay_segment(seg)
             ix = _SegmentIndex(bits=_bloom_bits_for(len(table)))
             # coverage = the size snapshot the replay was keyed on (the
@@ -604,10 +611,38 @@ class PevlogEvents(base.EventStore):
                 ix.add(ev)
             ix.mem_size = snap
             _persist_index(seg, ix)
-        else:
-            ix.mem_size = ix.synced
         self.c.index_cache[key] = ix
         return ix
+
+    def _extend_index(self, seg: Path, ix: _SegmentIndex,
+                      size: int) -> None:
+        """Catch a prefix-covering sidecar up over the journal tail —
+        indexes are add-only, so decoding frames from `synced` onward
+        and adding their parts is equivalent to a full rebuild at a
+        fraction of the cost (no Event construction, no re-decode of
+        covered frames). Migrated-evlog tombstone frames are skipped:
+        they only remove table entries, and Bloom bits are monotonic."""
+        consumed = ix.synced
+        added = 0
+        for payload, end in EventLog(str(seg)).scan_from(ix.synced):
+            obj = json.loads(payload)
+            if "$tombstone" not in obj:
+                if "tus" in obj:
+                    ix.add_parts(obj["tus"], obj["et"], obj["ei"],
+                                 obj["e"], obj.get("tet"),
+                                 obj.get("tei"), obj.get("p"))
+                else:               # evlog-format frame
+                    ix.add(_payload_to_event(obj))
+                added += 1
+            consumed = end
+        ix.mem_size = consumed
+        ix.dirty += added
+        if added:
+            try:
+                _persist_index(seg, ix)
+                ix.dirty = 0
+            except OSError:         # read-only mount: stay in-memory
+                pass
 
     # -- replay --------------------------------------------------------------
     def _scan_journal(self, path: Path, apply_frame) -> dict:
@@ -835,14 +870,16 @@ class PevlogEvents(base.EventStore):
                 seg = self._segment_path(part, bucket)
                 ix = self._index(seg)
                 # pre-size a FRESH segment's Blooms: without this, bulk
-                # ingest saturates the default filter repeatedly and
-                # each regrow re-adds every key. A big batch is the
-                # scale hint — a caller inserting 100k events at once
-                # will insert more, so size fresh segments for the WHOLE
-                # batch, not this segment's slice of it (measured: cuts
-                # regrow re-adds from ~60% of adds to ~zero)
+                # ingest saturates the default filter repeatedly. The
+                # batch is the scale hint (a caller inserting 100k
+                # events will insert more), CAPPED at 8x this segment's
+                # slice — a batch spread over many segments must not
+                # give every segment a whole-batch-sized filter, whose
+                # serialization then dominates the sidecar persists
+                # (digest-tracked regrows make under-sizing cheap)
                 need = _bloom_bits_for(
-                    max(ix.count + len(triples), len(events)))
+                    max(ix.count + len(triples),
+                        min(len(events), 8 * len(triples))))
                 if need > ix.bits and ix.count == 0 and ix.filled == 0 \
                         and ix.tfilled == 0 and ix.pfilled == 0:
                     grown = _SegmentIndex(bits=need)
@@ -881,7 +918,8 @@ class PevlogEvents(base.EventStore):
                         ix = grown
                         self.c.index_cache[str(seg)] = ix
                 ix.dirty += len(triples)
-                if ix.dirty >= _IDX_FLUSH_EVERY:
+                if ix.dirty >= _IDX_FLUSH_MIN and \
+                        ix.dirty * _IDX_FLUSH_FRACTION >= ix.count:
                     _persist_index(seg, ix)
                     ix.dirty = 0
         return out_ids

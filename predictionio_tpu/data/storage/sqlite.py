@@ -559,15 +559,22 @@ class SQLiteEvents(base.EventStore):
         order = " ORDER BY eventtime DESC, id DESC" if reversed \
             else " ORDER BY eventtime ASC, id ASC"
         # a property filter is applied post-SQL (the properties column is
-        # a JSON blob), so the LIMIT must move after it
+        # a JSON blob), so the LIMIT moves after it — streaming the
+        # cursor and stopping at `limit` matches, never materializing
+        # the unfiltered table
         lim = f" LIMIT {int(limit)}" \
             if limit is not None and limit > 0 and not properties else ""
         with self.c.lock:
-            rows = self.c.conn.execute(
-                f"SELECT * FROM {t}{where}{order}{lim}", params).fetchall()
-        events = [self._row_to_event(r) for r in rows]
-        if properties:
-            events = [e for e in events if _match_properties(e, properties)]
-            if limit is not None and limit > 0:
-                events = events[:limit]
+            cur = self.c.conn.execute(
+                f"SELECT * FROM {t}{where}{order}{lim}", params)
+            if not properties:
+                events = [self._row_to_event(r) for r in cur.fetchall()]
+            else:
+                events = []
+                for r in cur:
+                    e = self._row_to_event(r)
+                    if _match_properties(e, properties):
+                        events.append(e)
+                        if limit is not None and 0 < limit <= len(events):
+                            break
         return iter(events)

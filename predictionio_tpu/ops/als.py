@@ -81,7 +81,12 @@ from predictionio_tpu.ingest import BiMap, RatingColumns
 # whole training step (row-rate-bound at ~390-450M rows/s on a v5e; see
 # module docstring), so padding is gather wall-clock 1:1.
 _BUCKET_BASE = 16
-_BUCKET_GROWTH = 1.5
+# cap-ladder growth. 1.25 holds ML-25M's padded/real entry ratio to
+# ~1.12 (1.5 measured 1.27 — r4 bench roofline), cutting EVERY phase of
+# the row-rate-bound step ~11%; the cost is more distinct slab shapes
+# (26 vs 15 item-side at ML-25M) in the one compiled program, which the
+# persistent XLA compile cache amortizes across runs.
+_BUCKET_GROWTH = 1.25
 
 # sentinel row index for slab padding rows (scatter mode="drop" discards
 # them; _pack_by_owner maps them to an in-range dropped local slot)
@@ -385,6 +390,24 @@ def _solve_slab_paired(own, opp_cast, rows, idx, val, reg, alpha, yty,
         bf16, independent of row WIDTH up to 128 lanes) — it is the
         step's hard floor, so the gathered operand is cast (`cast`,
         normally bfloat16) and every padded slot counts.
+
+        WHY THE GATHER FLOOR IS PHYSICAL (the r4->r5 Pallas question):
+        the measured rate is invariant in row width up to 128 lanes,
+        i.e. the cost is per ROW FETCHED, not per byte — the random-row
+        fetch issue rate of the memory system, at ~0.4-0.5 rows/cycle.
+        A hand-written Pallas kernel has exactly one primitive for the
+        same access pattern (a dynamic-slice row copy per index, issued
+        from a scalar loop), which bottlenecks on the same issue path;
+        a VMEM-resident table is out (the ML-25M user table alone is
+        21 MB bf16 > 16 MB VMEM, and splitting it doubles index
+        traffic); and a one-hot-matmul "gather on the MXU" pays
+        N*R/(2R^2) ~ 460x junk FLOPs at ML-25M shapes. Entry-level
+        Zipf reuse can't be cached either: the top-512-item hot set
+        covers only ~9% of entries at the catalog's s=0.5 skew. What
+        DOES shrink the floor is gathering fewer rows — the cap-ladder
+        growth of 1.25 (padding ~1.12x, was 1.27x) is that lever; a
+        fused gather+Gram kernel would only relocate, not remove, the
+        per-row fetch cost.
       * A batched [K,R]x[K,R] Gram per row runs the MXU at <2 TFLOP/s
         because each batch element only fills a RxR corner of the
         128x128 systolic array. Pairing consecutive rows (lane-concat of
