@@ -23,6 +23,45 @@ class TestEmit:
                        "vs_baseline": 2.0}
 
 
+class TestDeferred:
+    def test_deferred_prints_last(self, capsys):
+        def sec():
+            bench.emit("headline", 42, "s", 2.0, defer=True)
+            bench.emit("early", 1, "u", 1.0)
+        bench.section(sec)
+        bench.emit("mid", 2, "u", 1.0)
+        bench._flush_deferred()
+        assert [r["metric"] for r in _lines(capsys)] == \
+            ["early", "mid", "headline"]
+        assert bench._DEFERRED == {}
+
+    def test_sigterm_handler_flushes_deferred_and_buffer(self):
+        # the handler must write the headline even mid-section; exercise
+        # it in a subprocess (it os._exits)
+        import subprocess
+        import sys as _sys
+        code = (
+            "import os, signal, bench\n"
+            "bench.emit('headline', 1, 's', 1.0, defer=True)\n"
+            "bench._METRIC_BUFFER = {}\n"
+            "bench.emit('partial', 2, 'u', 1.0)\n"
+            "bench._on_sigterm(signal.SIGTERM, None)\n")
+        out = subprocess.run(
+            [_sys.executable, "-c", code], capture_output=True,
+            text=True, cwd=str(__import__('pathlib').Path(
+                bench.__file__).parent))
+        lines = [json.loads(ln) for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        assert [r["metric"] for r in lines] == ["partial", "headline"]
+        assert out.returncode == 1
+
+
+class TestBudget:
+    def test_remaining_counts_down(self):
+        assert bench.remaining() <= bench.BUDGET_S
+        assert bench.remaining() > 0 or bench.BUDGET_S < 1
+
+
 class TestSection:
     def test_flushes_in_emit_order(self, capsys):
         def ok():

@@ -243,6 +243,31 @@ class TestPruning:
         assert healed.event_names == {"view"}
         assert not healed.may_contain_event(["buy"])
 
+    def test_stale_sidecar_extends_over_tail_without_full_replay(
+            self, store, tmp_path):
+        # crash-restart path: a sidecar covering a PREFIX of the journal
+        # is caught up by decoding only the tail — and the extended
+        # index still prunes/answers correctly
+        store.insert_batch([_mk(0, f"u{n}") for n in range(300)], 1)
+        store.close()                      # sidecar covers 300 events
+        store.insert_batch([_mk(0, "tail-user", name="tailbuy")], 1)
+        # simulate the crash: drop the in-memory index so the persisted
+        # (now stale) sidecar is what a fresh client sees
+        ev2 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        out = list(ev2.find(1, event_names=["tailbuy"]))
+        assert [e.entity_id for e in out] == ["tail-user"]
+        [seg] = tmp_path.glob("app_1/seg_*.log")
+        ix = ev2._index(seg)
+        assert ix.count == 301
+        assert ix.mem_size == seg.stat().st_size
+        # the extension persisted: a third client loads it clean
+        ev3 = PevlogEvents(PevlogStorageClient({"PATH": str(tmp_path),
+                                                "BUCKET_HOURS": 24}))
+        ix3 = ev3._index(seg)
+        assert ix3.synced == seg.stat().st_size
+        assert "tailbuy" in ix3.event_names
+
     def test_full_scan_still_correct(self, store):
         store.insert_batch(
             [_mk(d, f"u{d % 3}") for d in range(10)], 1)
