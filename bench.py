@@ -1522,7 +1522,14 @@ def section(fn, *a):
 def _setup_runtime():
     """Persistent XLA compile cache (r4 measured 187.6 s of one ml25m
     run as compile; the cache survives across bench runs on the same
-    host) and the SIGTERM evidence-flush handler."""
+    host), the SIGTERM evidence-flush handler, and a DEVICE LIVENESS
+    probe: the tunnel to the chip can be down for hours (observed), and
+    a dead tunnel hangs jax backend init forever — the probe runs
+    jax.devices() in a subprocess with a timeout and falls back to the
+    CPU platform so a chip outage still records every host-side metric
+    instead of an empty rc=124."""
+    import subprocess
+
     signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         import jax
@@ -1534,16 +1541,38 @@ def _setup_runtime():
     except Exception as e:   # noqa: BLE001 — cache is best-effort
         print(f"# xla compile cache unavailable: {e!r:.120}",
               file=sys.stderr)
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=180)
+        platform = probe.stdout.strip().splitlines()[-1] \
+            if probe.returncode == 0 and probe.stdout.strip() else None
+    except subprocess.TimeoutExpired:
+        platform = None
+    if platform is None:
+        print("# device probe FAILED (tunnel down?): forcing CPU so "
+              "host-side metrics still record", file=sys.stderr)
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:   # noqa: BLE001
+            pass
+    else:
+        print(f"# device probe: {platform}", file=sys.stderr)
 
 
 def main():
+    if "--only-pevlog" in sys.argv:
+        # jax-free section: skip the device probe (it would stall up to
+        # 180 s on a dead tunnel for a device this path never touches)
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        section(bench_pevlog)
+        return
     _setup_runtime()
     if "--only-ml25m" in sys.argv:
         section(bench_ml25m)
         _flush_deferred()
-        return
-    if "--only-pevlog" in sys.argv:
-        section(bench_pevlog)
         return
     if "--only-large-catalog" in sys.argv:
         section(bench_serving_large_catalog)

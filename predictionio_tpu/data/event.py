@@ -21,7 +21,11 @@ import time
 import uuid
 from dataclasses import dataclass, field, replace
 from datetime import datetime, timezone
-from typing import Any, Iterator, Mapping, Optional, Sequence  # noqa: F401
+# Mapping from the abc, not typing: isinstance against typing.Mapping
+# routes through typing's __instancecheck__ (~5 us per miss), and the
+# JSON-validation path runs it once per value
+from collections.abc import Mapping
+from typing import Any, Iterator, Optional, Sequence  # noqa: F401
 
 
 def utcnow() -> datetime:
@@ -122,6 +126,22 @@ class DataMap:
         fields = dict(fields or {})
         _check_json_value(fields, "$")
         self._fields = fields
+
+    @classmethod
+    def _trusted(cls, fields: Optional[dict]) -> "DataMap":
+        """Wrap an ALREADY-VALIDATED owned dict without copy or
+        re-validation — the journal replay hot path (frames were
+        validated at insert and CRC-checked at read; each json.loads
+        hands over a fresh dict). A non-dict (a foreign-written frame
+        with a scalar "p") falls back to the validating constructor so
+        it fails AT the decode site, not deep in a consumer."""
+        if fields is None:
+            fields = {}
+        elif not isinstance(fields, dict):
+            return cls(fields)       # raises the clear ValueError
+        dm = object.__new__(cls)
+        dm._fields = fields
+        return dm
 
     # -- dict-like protocol -------------------------------------------------
     def __getitem__(self, key: str) -> Any:

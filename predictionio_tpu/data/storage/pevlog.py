@@ -142,15 +142,21 @@ def _payload_for(e: Event, eid: str, t_us: int,
 def _decode_payload(obj: dict) -> Event:
     if "tus" not in obj:               # evlog-format frame
         return _payload_to_event(obj)
-    return Event(
+    # trusted construction: frames were validated at insert and
+    # CRC-checked at read, and each json.loads dict is owned by this
+    # frame — skip the dataclass __init__ and DataMap copy/re-check
+    # (measured ~25% of segment replay)
+    e = object.__new__(Event)
+    e.__dict__.update(
         event=obj["e"], entity_type=obj["et"], entity_id=obj["ei"],
         target_entity_type=obj.get("tet"),
         target_entity_id=obj.get("tei"),
-        properties=DataMap(obj.get("p", {})),
+        properties=DataMap._trusted(obj.get("p")),
         event_time=_from_us(obj["tus"]),
         creation_time=_from_us(obj["cus"]),
         event_id=obj["id"], tags=tuple(obj.get("g", ())),
         pr_id=obj.get("pr"))
+    return e
 
 _BLOOM_BITS = 1 << 16          # initial size: 8 KiB per segment
 _BLOOM_HASHES = 4
@@ -625,7 +631,9 @@ class PevlogEvents(base.EventStore):
         consumed = ix.synced
         added = 0
         for payload, end in EventLog(str(seg)).scan_from(ix.synced):
-            obj = json.loads(payload)
+            # str input: json.loads on bytes runs detect_encoding
+            # per frame (measured ~6% of replay)
+            obj = json.loads(payload.decode())
             if "$tombstone" not in obj:
                 if "tus" in obj:
                     ix.add_parts(obj["tus"], obj["et"], obj["ei"],
@@ -663,7 +671,9 @@ class PevlogEvents(base.EventStore):
         else:
             consumed, state = 0, {}
         for payload, end in EventLog(key).scan_from(consumed):
-            apply_frame(state, json.loads(payload))
+            # str input: json.loads on bytes runs detect_encoding
+            # per frame (measured ~6% of replay)
+            apply_frame(state, json.loads(payload.decode()))
             consumed = end
         self.c.replay_cache[key] = (size, consumed, state)
         return state
