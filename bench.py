@@ -1495,6 +1495,64 @@ def bench_twotower(n_events: int = 200_000):
          recall / (10 / n_items))
 
 
+def bench_seqrec(n_users: int = 20_000, n_items: int = 1_000,
+                 seq_len: int = 32):
+    """The sequential recommender (new capability; the long-context /
+    ring-attention path): planted item-chain data where the NEXT item is
+    determined by ORDER — an order-blind popularity recommender scores
+    ~k/n_items while the causal transformer learns the chain. Emits
+    train examples/s and next-item hit-rate@10 with the MEASURED
+    popularity baseline."""
+    from predictionio_tpu.ops.seqrec import (
+        build_sequences, seqrec_encode, seqrec_train,
+    )
+
+    if remaining() < 120:
+        n_users = 5_000
+        print(f"# budget: seqrec shrunk to {n_users} users "
+              f"(remaining {remaining():.0f}s)", file=sys.stderr)
+    rng = np.random.RandomState(5)
+    lens = rng.randint(8, 2 * seq_len, n_users)
+    total = int(lens.sum())
+    u = np.repeat(np.arange(n_users), lens)
+    starts = rng.randint(0, n_items, n_users)
+    offs = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+    noise = np.where(rng.rand(total) < 0.1, rng.randint(0, 7, total), 0)
+    i = (np.repeat(starts, lens) + offs + noise) % n_items
+    t = offs
+    seqs, targets = build_sequences(u, i, t, n_items=n_items,
+                                    seq_len=seq_len)
+    held = rng.rand(len(seqs)) < 0.1
+    str_, ttr = seqs[~held], targets[~held]
+
+    epochs = 10
+    # warm with the SAME batch count: the jitted epoch scans over all
+    # batches, so a shorter warm run compiles a different program and
+    # the timed run would pay the real compile
+    seqrec_train(str_, ttr, n_items=n_items,
+                 seq_len=seq_len, dim=64, n_heads=2, n_layers=2,
+                 batch_size=256, epochs=1, seed=0)   # warm compiles
+    t0 = time.perf_counter()
+    m = seqrec_train(str_, ttr, n_items=n_items, seq_len=seq_len,
+                     dim=64, n_heads=2, n_layers=2, batch_size=256,
+                     epochs=epochs, seed=0)
+    train_s = time.perf_counter() - t0
+    n_train = (len(str_) // 256) * 256
+    emit("seqrec_train_examples_per_s", n_train * epochs / train_s,
+         "examples_per_s", 1.0)
+
+    sh, th = seqs[held], targets[held]
+    vecs = seqrec_encode(m, sh)
+    scores = vecs @ m.item_emb.T
+    top10 = np.argpartition(-scores, 10, axis=1)[:, :10]
+    hr = float((top10 == th[:, None]).any(1).mean())
+    # measured popularity baseline on the same split
+    pop = np.bincount(ttr, minlength=n_items)
+    ptop = np.argsort(-pop)[:10]
+    phr = max(float(np.isin(th, ptop).mean()), 1e-9)
+    emit("seqrec_next_item_hitrate_at_10", hr, "rate", hr / phr)
+
+
 def section(fn, *a):
     """Run one bench section with buffered metrics and ONE retry: the
     bench runtime's compile service occasionally drops a connection
@@ -1577,12 +1635,13 @@ def main():
     if "--only-large-catalog" in sys.argv:
         section(bench_serving_large_catalog)
         return
-    if "--only-configs" in sys.argv:   # BASELINE configs 2-5
+    if "--only-configs" in sys.argv:   # BASELINE configs 2-5 + seqrec
         section(bench_classification)
         section(bench_similarproduct)
         section(bench_ecommerce)
         section(bench_ecommerce_scale)
         section(bench_twotower)
+        section(bench_seqrec)
         return
 
     # Order: cheap hard gates first, the expensive ingest sections last,
@@ -1599,6 +1658,7 @@ def main():
         section(bench_similarproduct)
         section(bench_ecommerce)
         section(bench_twotower)
+        section(bench_seqrec)
         section(bench_serving, u, i, r, n_users, n_items)
         section(bench_ecommerce_scale)
         section(bench_serving_large_catalog)

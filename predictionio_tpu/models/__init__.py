@@ -13,6 +13,9 @@
                        popularity fallback
                        (`examples/scala-parallel-ecommercerecommendation/`)
   twotower.py          two-tower neural recommender (new capability)
+  seqrec.py            sequential (next-item) transformer recommender
+                       with ring-attention sequence parallelism
+                       (new capability)
 
 Each module exposes an `engine()` factory and registers it under a short
 name with the workflow registry, so `engine.json` can reference either.

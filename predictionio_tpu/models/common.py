@@ -10,6 +10,37 @@ from predictionio_tpu.ingest import BiMap
 from predictionio_tpu.ops.topk import build_mask
 
 
+def score_and_rank(vecs: np.ndarray, item_emb: np.ndarray,
+                   items: BiMap, live: Sequence[tuple]):
+    """The shared embedding-scoring tail of the neural recommenders
+    (two-tower, seqrec): per-query masks from white/black lists, one
+    masked top-k matmul over the catalog, ItemScore assembly. `live` is
+    [(original_index, query, ...)] — only index and query are read.
+    Returns [(original_index, PredictedResult)]."""
+    from predictionio_tpu.models.recommendation import (
+        ItemScore, PredictedResult,
+    )
+    from predictionio_tpu.ops.topk import NEG_INF, topk_scores
+
+    n_items = item_emb.shape[0]
+    k = max(min(entry[1].num, n_items) for entry in live)
+    mask = np.concatenate(
+        [resolve_item_mask(items, white_list=entry[1].whiteList,
+                           black_list=entry[1].blackList or ())
+         for entry in live], axis=0)
+    scores, ixs = topk_scores(vecs.astype(np.float32), item_emb, mask,
+                              k=k)
+    scores, ixs = np.asarray(scores), np.asarray(ixs)
+    out = []
+    for row, entry in enumerate(live):
+        i, q = entry[0], entry[1]
+        found = [ItemScore(items.inverse(int(ix)), float(s))
+                 for s, ix in zip(scores[row], ixs[row])
+                 if s > NEG_INF / 2][:q.num]
+        out.append((i, PredictedResult(tuple(found))))
+    return out
+
+
 def resolve_item_mask(items: BiMap,
                       item_categories: Optional[Dict[str, List[str]]] = None,
                       *,

@@ -1,0 +1,181 @@
+"""Sequential recommender template (new capability).
+
+No reference analog — the reference's recommenders are order-blind
+(ALS over a rating matrix); this template predicts the NEXT item from
+the ORDER of a user's events with a causal transformer
+(`ops/seqrec.py`), the framework's long-context / sequence-parallel
+proof point (ring attention over the mesh "sp" axis).
+
+Uses the recommendation template's event shapes and query/result wire
+format (swap `"engineFactory": "recommendation"` for `"seqrec"` in
+engine.json and retrain). Serving re-reads the user's RECENT events
+from the store at query time — the e-commerce template's
+serve-time-read pattern (ECommAlgorithm.scala:331-430) — so a user's
+newest activity influences their very next recommendation without
+retraining.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm, DataSource, Engine, EngineFactory, FirstServing,
+    IdentityPreparator, Params, RuntimeContext, register_engine,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.ingest import BiMap, RatingColumns
+from predictionio_tpu.models.recommendation import (
+    PredictedResult, Query,
+)
+from predictionio_tpu.ops.seqrec import (
+    SeqRecModel, build_sequences, seqrec_encode, seqrec_train,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "default"
+    channel: Optional[str] = None
+    event_names: Sequence[str] = ("view", "rate", "buy")
+
+
+class SeqRecDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> RatingColumns:
+        p = self.params
+        return RatingColumns.from_events(
+            store.find_events(ctx.registry, p.app_name, p.channel,
+                              event_names=list(p.event_names)),
+            rating_of=lambda e: 1.0)
+
+
+@dataclass
+class SeqRecServingModel:
+    net: SeqRecModel
+    users: BiMap
+    items: BiMap
+
+    def sanity_check(self):
+        self.net.sanity_check()
+
+
+@dataclass(frozen=True)
+class SeqRecParams(Params):
+    app_name: str = "default"           # serve-time history reads
+    channel: Optional[str] = None
+    event_names: Sequence[str] = ("view", "rate", "buy")
+    seq_len: int = 32
+    dim: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    batch_size: int = 256
+    epochs: int = 20
+    lr: float = 3e-3
+    temperature: float = 0.07
+    seed: Optional[int] = None
+
+
+class SeqRecAlgorithm(Algorithm):
+    params_class = SeqRecParams
+    query_class = Query
+
+    def train(self, ctx: RuntimeContext,
+              pd: RatingColumns) -> SeqRecServingModel:
+        p = self.params
+        self._serving_ctx = ctx
+        if pd.n == 0:
+            raise ValueError("No interaction events found")
+        seqs, targets = build_sequences(
+            pd.user_ix, pd.item_ix, pd.t_millis,
+            n_items=len(pd.items), seq_len=p.seq_len)
+        if not len(seqs):
+            raise ValueError(
+                "No user has >= 2 events; sequences cannot be built")
+        bsz = min(p.batch_size, len(seqs))
+        net = seqrec_train(
+            seqs, targets, n_items=len(pd.items), seq_len=p.seq_len,
+            dim=p.dim, n_heads=p.n_heads, n_layers=p.n_layers,
+            batch_size=bsz, epochs=p.epochs, lr=p.lr,
+            temperature=p.temperature,
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+        return SeqRecServingModel(net, pd.users, pd.items)
+
+    # -- serving -------------------------------------------------------------
+    def _ctx(self) -> RuntimeContext:
+        ctx = getattr(self, "_serving_ctx", None)
+        if ctx is None:
+            raise RuntimeError(
+                "SeqRecAlgorithm.predict needs a serving context for "
+                "its event-store reads; train/deploy through the Engine "
+                "workflow, or call with_serving_context(ctx) first")
+        return ctx
+
+    def with_serving_context(self, ctx: RuntimeContext) -> None:
+        self._serving_ctx = ctx
+
+    def _history(self, model: SeqRecServingModel, user: str) -> List[int]:
+        """The user's most recent item ids (store read, newest last)."""
+        p = self.params
+        try:
+            events = list(store.find_by_entity(
+                self._ctx().registry, p.app_name, channel_name=p.channel,
+                entity_type="user", entity_id=user,
+                event_names=list(p.event_names),
+                limit=model.net.seq_len, latest_first=True))
+        except store.AppNotFoundError:
+            return []
+        hist = [ix for e in reversed(events)
+                if e.target_entity_id is not None
+                and (ix := model.items.get(e.target_entity_id)) is not None]
+        return hist[-model.net.seq_len:]
+
+    def predict(self, model: SeqRecServingModel,
+                query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: SeqRecServingModel,
+                      queries: Sequence[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        out: List[Tuple[int, PredictedResult]] = []
+        live = []
+        S = model.net.seq_len
+        n_items = model.net.n_items
+        for i, q in queries:
+            hist = self._history(model, q.user)
+            if not hist:
+                out.append((i, PredictedResult()))
+            else:
+                live.append((i, q, hist))
+        if not live:
+            return out
+        seqs = np.full((len(live), S), n_items, np.int32)
+        for row, (_, _, hist) in enumerate(live):
+            seqs[row, S - len(hist):] = hist
+        vecs = seqrec_encode(model.net, seqs)
+        from predictionio_tpu.models.common import score_and_rank
+        out.extend(score_and_rank(vecs, model.net.item_emb,
+                                  model.items, live))
+        return out
+
+
+class SeqRecEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source=SeqRecDataSource,
+            preparator=IdentityPreparator,
+            algorithms={"seqrec": SeqRecAlgorithm, "": SeqRecAlgorithm},
+            serving=FirstServing,
+        )
+
+
+def engine() -> Engine:
+    return SeqRecEngine.apply()
+
+
+register_engine("seqrec", SeqRecEngine)

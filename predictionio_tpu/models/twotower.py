@@ -21,9 +21,8 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import BiMap, RatingColumns
 from predictionio_tpu.models.recommendation import (
-    ItemScore, PredictedResult, Query,
+    PredictedResult, Query,
 )
-from predictionio_tpu.ops.topk import NEG_INF, topk_scores
 from predictionio_tpu.ops.twotower import TwoTowerModel, twotower_train
 
 
@@ -102,22 +101,10 @@ class TwoTowerAlgorithm(Algorithm):
                 live.append((i, q, u))
         if not live:
             return out
-        n_items = model.net.item_emb.shape[0]
-        k = max(min(q.num, n_items) for _, q, _ in live)
         vecs = model.net.user_emb[np.array([u for _, _, u in live])]
-        from predictionio_tpu.models.common import resolve_item_mask
-        mask = np.concatenate(
-            [resolve_item_mask(model.items, white_list=q.whiteList,
-                               black_list=q.blackList or ())
-             for _, q, _ in live], axis=0)
-        scores, ixs = topk_scores(vecs.astype(np.float32),
-                                  model.net.item_emb, mask, k=k)
-        scores, ixs = np.asarray(scores), np.asarray(ixs)
-        for row, (i, q, _) in enumerate(live):
-            items = [ItemScore(model.items.inverse(int(ix)), float(s))
-                     for s, ix in zip(scores[row], ixs[row])
-                     if s > NEG_INF / 2][:q.num]
-            out.append((i, PredictedResult(tuple(items))))
+        from predictionio_tpu.models.common import score_and_rank
+        out.extend(score_and_rank(vecs, model.net.item_emb,
+                                  model.items, live))
         return out
 
 

@@ -56,6 +56,38 @@ class TestDeferred:
         assert out.returncode == 1
 
 
+class TestFanout:
+    def test_retry_reset_unwraps_urlerror(self):
+        import urllib.error
+        calls = []
+
+        def flaky(i):
+            calls.append(i)
+            if len(calls) == 1:
+                raise urllib.error.URLError(ConnectionResetError(104, "x"))
+
+        dt = bench._fanout(flaky, 1, 2, retry_reset=True)
+        assert dt >= 0 and calls == [0, 0, 1]
+
+    def test_no_retry_without_flag(self):
+        def always_reset(i):
+            raise ConnectionResetError(104, "x")
+
+        with pytest.raises(SystemExit):
+            bench._fanout(always_reset, 1, 1)
+
+    def test_non_reset_errors_never_retried(self):
+        calls = []
+
+        def boom(i):
+            calls.append(i)
+            raise RuntimeError("real failure")
+
+        with pytest.raises(SystemExit):
+            bench._fanout(boom, 1, 2, retry_reset=True)
+        assert calls == [0]
+
+
 class TestBudget:
     def test_remaining_counts_down(self):
         assert bench.remaining() <= bench.BUDGET_S

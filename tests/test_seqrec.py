@@ -1,0 +1,181 @@
+"""Sequential recommender: sequence building, planted-Markov learning
+(order-aware where popularity cannot be), mesh training with ring
+attention, and the engine template end to end with serve-time history
+reads."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from predictionio_tpu.ops.seqrec import (
+    build_sequences, seqrec_encode, seqrec_train,
+)
+
+
+def _markov_events(n_users=800, n_items=100, seed=0):
+    """Planted chain: each user walks item -> item+1 (mod n) with 10%
+    noise — the NEXT item is determined by ORDER, not popularity."""
+    rng = np.random.RandomState(seed)
+    us, its, ts = [], [], []
+    for u in range(n_users):
+        L = rng.randint(5, 16)
+        start = rng.randint(0, n_items)
+        for j in range(L):
+            noise = rng.randint(5) if rng.rand() < 0.1 else 0
+            us.append(u)
+            its.append((start + j + noise) % n_items)
+            ts.append(j)
+    return (np.asarray(us), np.asarray(its), np.asarray(ts),
+            n_items)
+
+
+class TestBuildSequences:
+    def test_right_aligned_with_targets(self):
+        u = np.array([7, 7, 7, 9])
+        i = np.array([3, 4, 5, 1])
+        t = np.array([0, 1, 2, 0])
+        seqs, targets = build_sequences(u, i, t, n_items=10, seq_len=4)
+        # user 9 has a single event: dropped (min_len=2)
+        assert seqs.shape == (1, 4)
+        np.testing.assert_array_equal(seqs[0], [10, 10, 3, 4])
+        assert targets[0] == 5
+
+    def test_truncates_to_recent(self):
+        u = np.zeros(10, np.int64)
+        i = np.arange(10)
+        t = np.arange(10)
+        seqs, targets = build_sequences(u, i, t, n_items=20, seq_len=4)
+        np.testing.assert_array_equal(seqs[0], [5, 6, 7, 8])
+        assert targets[0] == 9
+
+    def test_orders_by_time_not_input_order(self):
+        u = np.array([1, 1, 1])
+        i = np.array([5, 3, 4])
+        t = np.array([2, 0, 1])          # true order: 3, 4, 5
+        seqs, targets = build_sequences(u, i, t, n_items=10, seq_len=4)
+        np.testing.assert_array_equal(seqs[0], [10, 10, 3, 4])
+        assert targets[0] == 5
+
+
+class TestTraining:
+    def test_learns_planted_markov_chain(self):
+        u, i, t, n_items = _markov_events()
+        seqs, targets = build_sequences(u, i, t, n_items=n_items,
+                                        seq_len=8)
+        m = seqrec_train(seqs, targets, n_items=n_items, seq_len=8,
+                         dim=48, n_heads=2, n_layers=1, batch_size=256,
+                         epochs=15, seed=0)
+        vecs = seqrec_encode(m, seqs[:400])
+        acc = float((np.argmax(vecs @ m.item_emb.T, 1)
+                     == targets[:400]).mean())
+        # order-blind popularity would get ~1/n_items; the chain is
+        # learnable to ~0.9 (noise ceiling)
+        assert acc > 0.3, acc
+
+    def test_mesh_training_with_ring_attention(self):
+        u, i, t, n_items = _markov_events(n_users=300, seed=1)
+        seqs, targets = build_sequences(u, i, t, n_items=n_items,
+                                        seq_len=8)
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2),
+                    ("data", "sp"))
+        m = seqrec_train(seqs, targets, n_items=n_items, seq_len=8,
+                         dim=32, n_heads=2, n_layers=1, batch_size=128,
+                         epochs=2, seed=0, mesh=mesh)
+        vecs = seqrec_encode(m, seqs[:64])
+        assert np.isfinite(vecs).all()
+        m.sanity_check()
+
+    def test_mesh_and_single_device_agree_at_init(self):
+        # one epoch, same seed: the sharded loss/grads must match the
+        # single-device path closely (same math, different association)
+        u, i, t, n_items = _markov_events(n_users=260, seed=2)
+        seqs, targets = build_sequences(u, i, t, n_items=n_items,
+                                        seq_len=8)
+        seqs, targets = seqs[:256], targets[:256]
+        mesh = Mesh(np.array(jax.devices()).reshape(1, 8),
+                    ("data", "sp"))
+        m1 = seqrec_train(seqs, targets, n_items=n_items, seq_len=8,
+                          dim=32, n_heads=2, n_layers=1,
+                          batch_size=256, epochs=1, seed=0)
+        m2 = seqrec_train(seqs, targets, n_items=n_items, seq_len=8,
+                          dim=32, n_heads=2, n_layers=1,
+                          batch_size=256, epochs=1, seed=0, mesh=mesh)
+        d = np.abs(m1.item_emb - m2.item_emb).max()
+        assert d < 5e-3, d
+
+
+class TestEngineTemplate:
+    @pytest.fixture
+    def registry(self, tmp_path):
+        from predictionio_tpu.data.storage import StorageRegistry
+        return StorageRegistry({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+
+    def test_end_to_end_with_serve_time_history(self, registry):
+        from predictionio_tpu.core import (
+            CoreWorkflow, EngineParams, RuntimeContext, resolve_engine,
+        )
+        from predictionio_tpu.data.event import DataMap, Event
+        from predictionio_tpu.data.storage import App, set_default
+        from predictionio_tpu.models import seqrec as sr
+
+        set_default(registry)
+        app_id = registry.get_meta_data_apps().insert(App(0, "seqapp"))
+        events = registry.get_events()
+        events.init(app_id)
+        rng = np.random.RandomState(0)
+        batch = []
+        from datetime import datetime, timedelta, timezone
+        t0 = datetime(2024, 1, 1, tzinfo=timezone.utc)
+        n_items = 40
+        for u in range(120):
+            start = rng.randint(0, n_items)
+            for j in range(6):
+                batch.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(start + j) % n_items}",
+                    properties=DataMap({}),
+                    event_time=t0 + timedelta(minutes=j)))
+        for s in range(0, len(batch), 50):
+            events.insert_batch(batch[s:s + 50], app_id)
+
+        engine = resolve_engine("seqrec")
+        params = EngineParams(
+            data_source_params=("", sr.DataSourceParams(
+                app_name="seqapp")),
+            algorithm_params_list=(("seqrec", sr.SeqRecParams(
+                app_name="seqapp", seq_len=8, dim=32, n_heads=2,
+                n_layers=1, batch_size=64, epochs=25, seed=1)),))
+        ctx = RuntimeContext(registry=registry)
+        row = CoreWorkflow.run_train(engine, params, ctx)
+        algos, models, _ = CoreWorkflow.prepare_deploy(engine, row, ctx)
+        algo, model = algos[0], models[0]
+
+        res = algo.predict(model, sr.Query(user="u3", num=5))
+        assert len(res.itemScores) == 5
+        # unknown user (no history): empty result, no crash
+        res = algo.predict(model, sr.Query(user="nobody", num=5))
+        assert res.itemScores == ()
+        # the chain structure should place the user's true next item
+        # into the top-5 for most users
+        hits = 0
+        for u in range(40):
+            res = algo.predict(model, sr.Query(user=f"u{u}", num=5))
+            got = {s.item for s in res.itemScores}
+            # last viewed item is (start+5); next in chain is start+6
+            # — recover start from the stored events instead of rng
+            evs = sorted(
+                (e for e in events.find(app_id, entity_type="user",
+                                        entity_id=f"u{u}")),
+                key=lambda e: e.event_time)
+            nxt = (int(evs[-1].target_entity_id[1:]) + 1) % n_items
+            hits += f"i{nxt}" in got
+        # random top-5 over 40 items would hit ~5; demand ~3x that
+        assert hits >= 14, hits
