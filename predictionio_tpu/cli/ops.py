@@ -385,6 +385,7 @@ _SCAFFOLD_BASES = {
     "classification": ("ClassificationDataSource", "NaiveBayesAlgorithm"),
     "ecommerce": ("ECommDataSource", "ECommAlgorithm"),
     "twotower": ("TwoTowerDataSource", "TwoTowerAlgorithm"),
+    "seqrec": ("SeqRecDataSource", "SeqRecAlgorithm"),
 }
 
 

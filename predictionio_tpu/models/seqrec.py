@@ -119,14 +119,18 @@ class SeqRecAlgorithm(Algorithm):
         self._serving_ctx = ctx
 
     def _history(self, model: SeqRecServingModel, user: str) -> List[int]:
-        """The user's most recent item ids (store read, newest last)."""
+        """The user's most recent item ids (store read, newest last).
+        Reads a LARGER window than seq_len before filtering: the model's
+        item map is frozen at training, so a burst of recent events on
+        post-training items must evict into older mappable history, not
+        empty it (history is this model's only input)."""
         p = self.params
         try:
             events = list(store.find_by_entity(
                 self._ctx().registry, p.app_name, channel_name=p.channel,
                 entity_type="user", entity_id=user,
                 event_names=list(p.event_names),
-                limit=model.net.seq_len, latest_first=True))
+                limit=4 * model.net.seq_len, latest_first=True))
         except store.AppNotFoundError:
             return []
         hist = [ix for e in reversed(events)

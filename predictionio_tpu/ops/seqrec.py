@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from predictionio_tpu.ops.attention import attention_reference, ring_attention
+from predictionio_tpu.ops.attention import ring_attention
 
 
 @dataclass
@@ -109,10 +109,8 @@ def _encode(params, seqs, *, n_items: int, n_heads: int, n_layers: int,
     valid = seqs != n_items                                # [B, S]
     x = params["item_table"][seqs] * np.sqrt(D) + params["pos_emb"]
 
-    attend = (partial(ring_attention, mesh=mesh) if mesh is not None
-              else (lambda q, k, v, causal, kv_mask:
-                    attention_reference(q, k, v, causal=causal,
-                                        kv_mask=kv_mask)))
+    # ring_attention's trivial-axis fall-through handles mesh=None too
+    attend = partial(ring_attention, mesh=mesh)
     for layer in range(n_layers):
         lp = params[f"l{layer}"]
         h = _ln(x, lp["ln1"], lp["ln1_b"])

@@ -106,6 +106,27 @@ class TestTraining:
         assert d < 5e-3, d
 
 
+class TestPersistence:
+    def test_model_pickles_without_device_cache(self):
+        import pickle
+
+        u, i, t, n_items = _markov_events(n_users=260, seed=3)
+        seqs, targets = build_sequences(u, i, t, n_items=n_items,
+                                        seq_len=8)
+        m = seqrec_train(seqs[:256], targets[:256], n_items=n_items,
+                         seq_len=8, dim=16, n_heads=2, n_layers=1,
+                         batch_size=256, epochs=1, seed=0)
+        # serving populates the device-param cache...
+        _ = seqrec_encode(m, seqs[:4])
+        assert getattr(m, "_devp", None) is not None
+        # ...which must NOT travel with the pickled model
+        m2 = pickle.loads(pickle.dumps(m))
+        assert getattr(m2, "_devp", None) is None
+        v1 = seqrec_encode(m, seqs[:4])
+        v2 = seqrec_encode(m2, seqs[:4])
+        np.testing.assert_allclose(v1, v2, atol=1e-6)
+
+
 class TestEngineTemplate:
     @pytest.fixture
     def registry(self, tmp_path):
