@@ -402,12 +402,18 @@ def template_new(directory: str, *, base: str = "recommendation") -> str:
     ds_class, algo_class = _SCAFFOLD_BASES[base]
     (target / "my_engine.py").write_text(_SCAFFOLD_ENGINE.format(
         base=base, ds_class=ds_class, algo_class=algo_class))
+    # bases whose ALGORITHM reads the event store at serve time carry
+    # app_name in their algo params too — omitting it would make
+    # serve-time reads silently target the 'default' app and return
+    # empty predictions
+    algo_params = ({"app_name": "myapp"}
+                   if base in ("ecommerce", "seqrec") else {})
     (target / "engine.json").write_text(json.dumps({
         "id": "default",
         "description": f"scaffold based on the {base} template",
         "engineFactory": "my_engine.engine",
         "datasource": {"params": {"app_name": "myapp"}},
-        "algorithms": [{"name": "", "params": {}}],
+        "algorithms": [{"name": "", "params": algo_params}],
     }, indent=2) + "\n")
     return str(target)
 

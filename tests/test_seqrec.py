@@ -200,3 +200,18 @@ class TestEngineTemplate:
             hits += f"i{nxt}" in got
         # random top-5 over 40 items would hit ~5; demand ~3x that
         assert hits >= 14, hits
+
+        # post-training catalog churn: a burst of recent events on
+        # UNKNOWN items must not empty the history window (the read is
+        # 4x seq_len wide before filtering to trained items)
+        burst = [Event(
+            event="view", entity_type="user", entity_id="u3",
+            target_entity_type="item", target_entity_id=f"newitem{j}",
+            properties=DataMap({}),
+            event_time=t0 + timedelta(hours=1, minutes=j))
+            for j in range(8)]          # seq_len recent unknown items
+        for s in range(0, len(burst), 50):
+            events.insert_batch(burst[s:s + 50], app_id)
+        res = algo.predict(model, sr.Query(user="u3", num=5))
+        assert len(res.itemScores) == 5, \
+            "history emptied by unknown-item burst"
