@@ -236,6 +236,10 @@ def main(argv: Optional[list] = None) -> int:
                 num_processes=args.num_processes,
                 process_id=args.process_id,
                 profile_dir=args.profile_dir))
+            # the timing report goes to stderr: stdout stays pure JSON
+            # for scripted callers parsing the result above
+            from predictionio_tpu.obs import train_report
+            print(train_report(), file=sys.stderr)
             return 0
         if cmd == "eval":
             _emit(ops.run_eval(_registry(), args.evaluation,

@@ -199,6 +199,7 @@ def train(registry, *, engine_json: str = "engine.json",
     Runner.scala:213-215,298-305)."""
     from predictionio_tpu.core import RuntimeContext, WorkflowParams
     from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
+    from predictionio_tpu.obs import compile_count, install_compile_probe
     from predictionio_tpu.parallel import initialize_distributed
 
     # flags override env inside initialize_distributed; nothing is
@@ -234,6 +235,10 @@ def train(registry, *, engine_json: str = "engine.json",
         prof_ctx = jax.profiler.trace(profile_dir)
     else:
         prof_ctx = contextlib.nullcontext()
+    # probe installed before training so this run's XLA compiles are
+    # counted; the delta (not the process total) is reported
+    install_compile_probe()
+    compiles_before = compile_count()
     with prof_ctx:
         row = CoreWorkflow.run_train(
             engine, engine_params, ctx,
@@ -244,6 +249,7 @@ def train(registry, *, engine_json: str = "engine.json",
             "startTime": format_time(row.start_time),
             "endTime": format_time(row.end_time),
             "phaseTimings": dict(ctx.phase_timings),
+            "jaxCompiles": int(compile_count() - compiles_before),
             "distributed": distributed, "persisted": persist}
 
 

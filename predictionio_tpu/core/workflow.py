@@ -12,7 +12,6 @@ Parity targets:
 from __future__ import annotations
 
 import importlib
-import traceback
 from typing import Any, List, Optional, Tuple
 
 from predictionio_tpu.core.engine import Engine, EngineFactory
@@ -25,6 +24,11 @@ from predictionio_tpu.data.event import utcnow
 from predictionio_tpu.data.storage.base import (
     EngineInstance, EngineInstanceStatus, Model,
 )
+from predictionio_tpu.obs import (
+    get_logger, install_compile_probe, record_train_phases,
+)
+
+_log = get_logger("workflow")
 
 # explicit registry complementing dotted-path import, so quickstart factories
 # can register under short names (the classpath-reflection analog)
@@ -89,8 +93,13 @@ class CoreWorkflow:
         it: they must participate in every collective, while only
         process 0 owns the metadata/model writes (the analog of Spark
         executors computing while the driver alone talks to storage)."""
+        # per-phase wall times and XLA compile counts land in the
+        # process-default metrics registry; the CLI renders its timing
+        # report from there (obs.train_report)
+        install_compile_probe()
         if not persist:
             engine.train(ctx, engine_params)
+            record_train_phases(ctx.phase_timings)
             return EngineInstance(
                 id="", status=EngineInstanceStatus.COMPLETED,
                 start_time=utcnow(), end_time=utcnow(),
@@ -119,6 +128,7 @@ class CoreWorkflow:
         instances.update(row)
         try:
             models = engine.train(ctx, engine_params)
+            record_train_phases(ctx.phase_timings)
             _, _, algos, _ = engine.make_components(engine_params)
             blob = serialize_models(instance_id, algos, models, ctx)
             registry.get_model_data_models().insert(Model(instance_id, blob))
@@ -131,8 +141,9 @@ class CoreWorkflow:
                               "phase_timings": dict(ctx.phase_timings)})
             instances.update(row)
             return row
-        except Exception:
-            traceback.print_exc()
+        except Exception as e:
+            _log.exception("train_failed", instance_id=instance_id,
+                           error=f"{type(e).__name__}: {e}")
             row = row.with_(status=EngineInstanceStatus.FAILED,
                             end_time=utcnow())
             instances.update(row)

@@ -33,6 +33,7 @@ from predictionio_tpu.data.plugins import (
 )
 from predictionio_tpu.data.stats import Stats
 from predictionio_tpu.data.storage import StorageRegistry, StorageWriteError, storage
+from predictionio_tpu.obs import MetricsRegistry
 from predictionio_tpu.data.webhooks import FORM_CONNECTORS, JSON_CONNECTORS
 from predictionio_tpu.data.webhooks.connectors import (
     ConnectorException, connector_to_event,
@@ -43,6 +44,8 @@ from predictionio_tpu.utils.http import (
 
 MAX_EVENTS_PER_BATCH_REQUEST = 50  # EventServer.scala:70
 DEFAULT_QUERY_LIMIT = 20           # EventServer.scala:353
+PAYLOAD_BUCKETS = (256.0, 1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+                   1048576.0)
 
 
 @dataclass
@@ -62,15 +65,25 @@ class AuthData:
 
 class EventServer(HTTPServerBase):
     def __init__(self, config: Optional[EventServerConfig] = None,
-                 registry: Optional[StorageRegistry] = None):
+                 registry: Optional[StorageRegistry] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         self.config = config or EventServerConfig()
-        super().__init__(host=self.config.ip, port=self.config.port)
+        super().__init__(host=self.config.ip, port=self.config.port,
+                         metrics=metrics)
         self.registry = registry or storage()
         self.event_client = self.registry.get_events()
         self.access_keys_client = self.registry.get_meta_data_access_keys()
         self.channels_client = self.registry.get_meta_data_channels()
         self.stats = Stats()
         self.plugin_context = EventServerPluginContext(self.config.plugins)
+        self._ingest_counter = self.metrics.counter(
+            "pio_events_ingested_total",
+            "Events accepted into storage, by ingest surface",
+            labels=("via",))
+        self._payload_hist = self.metrics.histogram(
+            "pio_ingest_payload_bytes",
+            "Ingest request payload size in bytes",
+            buckets=PAYLOAD_BUCKETS)
         self._install_routes()
 
     # -- auth ---------------------------------------------------------------
@@ -95,7 +108,8 @@ class EventServer(HTTPServerBase):
         return AuthData(ak.appid, channel_id, ak.events)
 
     # -- ingestion helper ---------------------------------------------------
-    def _ingest(self, event: Event, auth: AuthData) -> str:
+    def _ingest(self, event: Event, auth: AuthData,
+                via: str = "single") -> str:
         info = EventInfo(auth.app_id, auth.channel_id, event)
         self.plugin_context.run_blockers(info)
         try:
@@ -106,6 +120,7 @@ class EventServer(HTTPServerBase):
             # error on every ingest surface: single, batch, and webhooks
             raise HTTPError(400, str(e))
         self.plugin_context.notify_sniffers(info)
+        self._ingest_counter.labels(via=via).inc()
         if self.config.stats:
             self.stats.bookkeeping(auth.app_id, 201, event)
         return event_id
@@ -139,6 +154,7 @@ class EventServer(HTTPServerBase):
         @r.post("/events.json")
         def post_event(req: Request) -> Response:
             auth = self._auth(req)
+            self._payload_hist.observe(float(len(req.body)))
             event = Event.from_api_json(req.json())
             if auth.events and event.event not in auth.events:
                 return Response.json(
@@ -194,6 +210,7 @@ class EventServer(HTTPServerBase):
         @r.post("/batch/events.json")
         def post_batch(req: Request) -> Response:
             auth = self._auth(req)
+            self._payload_hist.observe(float(len(req.body)))
             payload = req.json()
             if not isinstance(payload, list):
                 raise HTTPError(400, "Batch request body must be a JSON array")
@@ -214,7 +231,7 @@ class EventServer(HTTPServerBase):
                         "message": f"{event.event} events are not allowed"})
                     continue
                 try:
-                    event_id = self._ingest(event, auth)
+                    event_id = self._ingest(event, auth, via="batch")
                     results.append({"status": 201, "eventId": event_id})
                 except HTTPError as e:
                     results.append({"status": e.status, "message": e.message})
@@ -240,11 +257,12 @@ class EventServer(HTTPServerBase):
                 return Response.json(
                     {"message": f"webhooks connection for {name} is not "
                                 "supported."}, 404)
+            self._payload_hist.observe(float(len(req.body)))
             try:
                 event = connector_to_event(connector, req.json())
             except ConnectorException as e:
                 raise HTTPError(400, str(e))
-            event_id = self._ingest(event, auth)
+            event_id = self._ingest(event, auth, via="webhook")
             return Response.json({"eventId": event_id}, 201)
 
         @r.get("/webhooks/<name>.json")
@@ -265,6 +283,7 @@ class EventServer(HTTPServerBase):
                 return Response.json(
                     {"message": f"webhooks connection for {name} is not "
                                 "supported."}, 404)
+            self._payload_hist.observe(float(len(req.body)))
             fields = {k: v[0] for k, v in
                       parse_qs(req.body.decode("utf-8"),
                                keep_blank_values=True).items()}
@@ -272,7 +291,7 @@ class EventServer(HTTPServerBase):
                 event = connector_to_event(connector, fields)
             except ConnectorException as e:
                 raise HTTPError(400, str(e))
-            event_id = self._ingest(event, auth)
+            event_id = self._ingest(event, auth, via="webhook")
             return Response.json({"eventId": event_id}, 201)
 
         @r.get("/webhooks/<name>.form")

@@ -18,6 +18,11 @@ from predictionio_tpu.data.event import Event, utcnow
 # (appId, hourBucket, event, entityType, status) -> count
 _Key = Tuple[int, int, str, str, int]
 
+# get_stats only ever reads the current and previous hour; anything
+# older than this is dead weight that previously accumulated forever
+# on a long-lived event server
+PRUNE_AFTER_SECONDS = 2 * 3600
+
 
 def hour_bucket(t: datetime) -> int:
     return int(t.replace(minute=0, second=0, microsecond=0).timestamp())
@@ -28,6 +33,7 @@ class Stats:
         self._lock = threading.Lock()
         self._counts: Dict[_Key, int] = defaultdict(int)
         self.start_time = utcnow()
+        self._latest_bucket = 0
 
     def bookkeeping(self, app_id: int, status_code: int, event: Event,
                     now: Optional[datetime] = None) -> None:
@@ -35,6 +41,13 @@ class Stats:
         with self._lock:
             self._counts[(app_id, b, event.event, event.entity_type,
                           status_code)] += 1
+            # amortized prune: only scan when the clock crosses into a
+            # new hour, dropping buckets no snapshot can reach anymore
+            if b > self._latest_bucket:
+                self._latest_bucket = b
+                cutoff = b - PRUNE_AFTER_SECONDS
+                for k in [k for k in self._counts if k[1] <= cutoff]:
+                    del self._counts[k]
 
     def _snapshot(self, app_id: int, bucket: int) -> List[dict]:
         return [

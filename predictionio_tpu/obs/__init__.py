@@ -1,0 +1,25 @@
+"""Unified observability layer: metrics, structured logging, tracing.
+
+The standard instrumentation surface for every layer of the stack
+(`pio_*` metric families). Servers expose the process-default registry
+on `GET /metrics` (Prometheus text format); the HTTP middleware in
+`utils.http` emits one structured JSON log line per request with a
+propagated request id; the serve chain, event ingestion, and the train
+workflow all record into the same registry. Future perf PRs report
+through this package instead of ad-hoc prints and time.time() — the
+lint gate (`tools.lint`) enforces it in serving/, data/, and core/.
+"""
+
+from predictionio_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    get_registry,
+)
+from predictionio_tpu.obs.logs import (  # noqa: F401
+    StructuredLogger, get_logger, new_request_id,
+)
+from predictionio_tpu.obs.jaxprobe import (  # noqa: F401
+    compile_count, install_compile_probe,
+)
+from predictionio_tpu.obs.report import (  # noqa: F401
+    record_train_phases, train_report,
+)

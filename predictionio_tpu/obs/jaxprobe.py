@@ -1,0 +1,65 @@
+"""JAX compile-cache-miss probe.
+
+Every jit cache miss that reaches the XLA compiler emits the
+`/jax/core/compile/backend_compile_duration` event on jax.monitoring's
+duration stream (jax/_src/dispatch.py BACKEND_COMPILE_EVENT). Counting
+those events counts real backend compilations — recompiles from shape
+churn or cache invalidation show up here long before they show up as
+mystery latency. `pio train` reports the per-run delta next to its phase
+timings (the tf.data-service-style "where did the time go" telemetry).
+
+jax.monitoring listeners are process-global and cannot be removed
+individually, so installation is once-per-process into the
+process-default registry; `install_compile_probe` is idempotent.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from predictionio_tpu.obs.metrics import MetricsRegistry, get_registry
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0)
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _instruments(registry: MetricsRegistry):
+    counter = registry.counter(
+        "pio_jax_backend_compiles_total",
+        "XLA backend compilations (jit compile-cache misses)")
+    hist = registry.histogram(
+        "pio_jax_backend_compile_seconds",
+        "XLA backend compile wall time per compilation",
+        buckets=COMPILE_BUCKETS)
+    return counter, hist
+
+
+def install_compile_probe(
+        registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the jax.monitoring listener (once per process). Counts
+    land in `registry` (default: the process-default registry)."""
+    global _installed
+    counter, hist = _instruments(registry or get_registry())
+    with _install_lock:
+        if _installed:
+            return
+        from jax import monitoring   # lazy: obs must import without jax
+
+        def _on_duration(event: str, duration: float, **kwargs) -> None:
+            if event == BACKEND_COMPILE_EVENT:
+                counter.inc()
+                hist.observe(duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+
+
+def compile_count(registry: Optional[MetricsRegistry] = None) -> int:
+    """Current backend-compile count (0 before the probe ever fired)."""
+    counter, _ = _instruments(registry or get_registry())
+    return int(counter.value)

@@ -1,0 +1,55 @@
+"""Train-phase metric recording and the `pio train` timing report.
+
+`CoreWorkflow.run_train` records each phase wall time (read / prepare /
+per-algorithm train) into the process-default metrics registry; the CLI
+then prints a human-readable per-phase report SOURCED FROM that registry
+— the same numbers a scraper would see on /metrics — alongside the JAX
+backend-compile count from the compile probe ([[jaxprobe]]).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from predictionio_tpu.obs.metrics import MetricsRegistry, get_registry
+
+TRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+                 1800.0, 7200.0)
+
+
+def record_train_phases(phase_timings: Mapping[str, float],
+                        registry: Optional[MetricsRegistry] = None) -> None:
+    """Record a train run's per-phase wall seconds (keys like 'read_s',
+    'prepare_s', 'train_algo0_s') into the registry."""
+    reg = registry or get_registry()
+    hist = reg.histogram(
+        "pio_train_phase_seconds", "Training phase wall time per run",
+        labels=("phase",), buckets=TRAIN_BUCKETS)
+    for key, secs in phase_timings.items():
+        phase = key[:-2] if key.endswith("_s") else key
+        hist.labels(phase=phase).observe(float(secs))
+
+
+def train_report(registry: Optional[MetricsRegistry] = None) -> str:
+    """Per-phase timing report rendered from the metrics registry."""
+    reg = registry or get_registry()
+    snap = reg.snapshot()
+    lines = ["Training phase report (from the metrics registry):"]
+    fam = snap.get("pio_train_phase_seconds")
+    if fam and fam["series"]:
+        for s in fam["series"]:
+            phase = s["labels"].get("phase", "?")
+            lines.append(f"  {phase:<20} {s['sum']:9.3f}s"
+                         f"  (runs: {s['count']})")
+    else:
+        lines.append("  (no training phases recorded)")
+    compiles = snap.get("pio_jax_backend_compiles_total")
+    if compiles and compiles["series"]:
+        n = int(compiles["series"][0]["value"])
+        secs = 0.0
+        durations = snap.get("pio_jax_backend_compile_seconds")
+        if durations and durations["series"]:
+            secs = durations["series"][0]["sum"]
+        lines.append(f"  jax_backend_compiles {n:9d}   ({secs:.3f}s "
+                     "in the XLA compiler)")
+    return "\n".join(lines)

@@ -28,12 +28,43 @@ from typing import Any, Dict, List, Optional, Sequence
 from predictionio_tpu.core import RuntimeContext, extract_params
 from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
+from predictionio_tpu.obs import MetricsRegistry, get_registry
 from predictionio_tpu.serving.plugins import (
     EngineServerPluginContext, QueryInfo,
 )
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
 )
+
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                      256.0, 512.0)
+
+
+class _ServeInstruments:
+    """The serve-chain metric families, shared by the server, its
+    deployments, and the micro-batcher (one registry, one set of
+    instruments)."""
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        metrics = metrics if metrics is not None else get_registry()
+        self.stage = metrics.histogram(
+            "pio_serve_stage_seconds",
+            "Serve-chain stage wall time (extract/supplement/predict/"
+            "serve/feedback)", labels=("stage",))
+        self.algo = metrics.histogram(
+            "pio_serve_algo_predict_seconds",
+            "Per-algorithm batch_predict wall time", labels=("algo",))
+        self.batch_size = metrics.histogram(
+            "pio_serve_batch_size",
+            "Coalesced device batch size per drain",
+            buckets=BATCH_SIZE_BUCKETS)
+        self.queue_depth = metrics.gauge(
+            "pio_serve_batch_queue_depth",
+            "Requests waiting in the micro-batcher")
+        self.feedback = metrics.counter(
+            "pio_feedback_events_total",
+            "Feedback events by outcome (sent/failed/dropped)",
+            labels=("outcome",))
 
 
 @dataclass
@@ -79,23 +110,33 @@ class _Deployment:
     """One loaded (engine, instance, algorithms, models, serving) set;
     replaced wholesale by /reload."""
 
-    def __init__(self, engine, instance, algos, models, serving):
+    def __init__(self, engine, instance, algos, models, serving,
+                 obs: Optional[_ServeInstruments] = None):
         self.engine = engine
         self.instance = instance
         self.algos = algos
         self.models = models
         self.serving = serving
+        self.obs = obs if obs is not None else _ServeInstruments()
         self.query_class = next(
             (a.query_class for a in algos if a.query_class is not None), None)
 
     def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
-        """supplement -> per-algo batch_predict -> serve, for a batch."""
-        supplemented = [self.serving.supplement(q) for q in queries]
+        """supplement -> per-algo batch_predict -> serve, for a batch;
+        each stage lands in pio_serve_stage_seconds."""
+        obs = self.obs
+        with obs.stage.labels(stage="supplement").time():
+            supplemented = [self.serving.supplement(q) for q in queries]
         indexed = list(enumerate(supplemented))
-        per_algo = [dict(a.batch_predict(m, indexed))
-                    for a, m in zip(self.algos, self.models)]
-        return [self.serving.serve(q, [pa[i] for pa in per_algo])
-                for i, q in enumerate(queries)]
+        per_algo: List[Dict[int, Any]] = []
+        with obs.stage.labels(stage="predict").time():
+            for i, (a, m) in enumerate(zip(self.algos, self.models)):
+                with obs.algo.labels(
+                        algo=f"{i}:{type(a).__name__}").time():
+                    per_algo.append(dict(a.batch_predict(m, indexed)))
+        with obs.stage.labels(stage="serve").time():
+            return [self.serving.serve(q, [pa[i] for pa in per_algo])
+                    for i, q in enumerate(queries)]
 
 
 class _MicroBatcher:
@@ -116,9 +157,11 @@ class _MicroBatcher:
     Device compute always runs OUTSIDE the lock so a drain never stalls
     submitters."""
 
-    def __init__(self, window_s: float, batch_max: int):
+    def __init__(self, window_s: float, batch_max: int,
+                 obs: Optional[_ServeInstruments] = None):
         self.window_s = window_s
         self.batch_max = batch_max
+        self.obs = obs if obs is not None else _ServeInstruments()
         self._lock = threading.Lock()
         # each item: (deployment, query, done event, result slot)
         self._pending: List[tuple] = []
@@ -129,6 +172,7 @@ class _MicroBatcher:
         slot: Dict[str, Any] = {}
         with self._lock:
             self._pending.append((deployment, query, done, slot))
+            self.obs.queue_depth.set(float(len(self._pending)))
             drain = not self._draining
             if drain:
                 self._draining = True
@@ -150,6 +194,7 @@ class _MicroBatcher:
             with self._lock:
                 batch = self._pending[:self.batch_max]
                 self._pending = self._pending[self.batch_max:]
+                self.obs.queue_depth.set(float(len(self._pending)))
                 if not batch:
                     # nothing arrived during the window: retire. The flag
                     # is cleared under the same lock any submit checks,
@@ -161,6 +206,7 @@ class _MicroBatcher:
     def _process(self, pending: List[tuple]) -> None:
         if not pending:
             return
+        self.obs.batch_size.observe(float(len(pending)))
         # group by deployment (reload may swap mid-flight)
         by_dep: Dict[int, List] = {}
         for item in pending:
@@ -184,11 +230,13 @@ class PredictionServer(HTTPServerBase):
 
     def __init__(self, config: ServerConfig, registry=None,
                  plugins: Optional[Sequence] = None,
-                 engine=None, instance=None):
-        super().__init__(host=config.ip, port=config.port)
+                 engine=None, instance=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        super().__init__(host=config.ip, port=config.port, metrics=metrics)
         from predictionio_tpu.utils.security import KeyAuthentication
 
         self.config = config
+        self._serve_obs = _ServeInstruments(self.metrics)
         self.ctx = RuntimeContext(registry=registry)
         self.plugin_context = EngineServerPluginContext(plugins)
         self.auth = KeyAuthentication(config.server_key or None)
@@ -196,7 +244,8 @@ class PredictionServer(HTTPServerBase):
         self._dep: Optional[_Deployment] = None
         self._dep_lock = threading.Lock()
         self._batcher = (_MicroBatcher(config.batch_window_ms / 1000.0,
-                                       config.batch_max)
+                                       config.batch_max,
+                                       obs=self._serve_obs)
                         if config.batch_window_ms > 0 else None)
         # latency bookkeeping (CreateServer.scala:399-401,584-591);
         # updated from concurrent handler threads, hence the lock.
@@ -235,7 +284,8 @@ class PredictionServer(HTTPServerBase):
         algos, models, serving = CoreWorkflow.prepare_deploy(
             engine, instance, self.ctx)
         with self._dep_lock:
-            self._dep = _Deployment(engine, instance, algos, models, serving)
+            self._dep = _Deployment(engine, instance, algos, models,
+                                    serving, obs=self._serve_obs)
 
     @staticmethod
     def _probe_occupant(host: str, port: int):
@@ -279,10 +329,11 @@ class PredictionServer(HTTPServerBase):
     def _serve_one(self, query_json: Any) -> Any:
         t0 = time.perf_counter()
         dep = self._dep
-        if dep.query_class is not None:
-            query = extract_params(dep.query_class, query_json)
-        else:
-            query = query_json
+        with self._serve_obs.stage.labels(stage="extract").time():
+            if dep.query_class is not None:
+                query = extract_params(dep.query_class, query_json)
+            else:
+                query = query_json
         if self._batcher is not None:
             prediction = self._batcher.submit(dep, query)
         else:
@@ -290,8 +341,9 @@ class PredictionServer(HTTPServerBase):
         # feedback loop + prId injection (CreateServer.scala:506-576)
         response_extra = {}
         if self.config.feedback:
-            pr_id = getattr(prediction, "prId", None) or _gen_pr_id()
-            self._post_feedback(dep, query, prediction, pr_id)
+            with self._serve_obs.stage.labels(stage="feedback").time():
+                pr_id = getattr(prediction, "prId", None) or _gen_pr_id()
+                self._post_feedback(dep, query, prediction, pr_id)
             if hasattr(prediction, "prId"):
                 response_extra["prId"] = pr_id
         prediction = self.plugin_context.run_blockers(
@@ -330,7 +382,8 @@ class PredictionServer(HTTPServerBase):
         try:
             self._feedback_queue.put_nowait(data)
         except queue.Full:
-            self.log_request_line("Feedback event dropped: queue full")
+            self._serve_obs.feedback.labels(outcome="dropped").inc()
+            self.obs_log.warning("feedback_dropped", reason="queue full")
 
     def _drain_feedback(self) -> None:
         import urllib.request
@@ -345,10 +398,16 @@ class PredictionServer(HTTPServerBase):
             try:
                 with urllib.request.urlopen(req, timeout=5) as resp:
                     if resp.status != 201:
-                        self.log_request_line(
-                            f"Feedback event failed. Status: {resp.status}")
+                        self._serve_obs.feedback.labels(
+                            outcome="failed").inc()
+                        self.obs_log.warning("feedback_failed",
+                                             status=resp.status)
+                    else:
+                        self._serve_obs.feedback.labels(
+                            outcome="sent").inc()
             except Exception as e:
-                self.log_request_line(f"Feedback event failed: {e}")
+                self._serve_obs.feedback.labels(outcome="failed").inc()
+                self.obs_log.warning("feedback_failed", error=str(e))
 
     # -- routes ---------------------------------------------------------------
     def _routes(self) -> None:

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import html
 from dataclasses import dataclass
+from typing import Optional
 
 from predictionio_tpu.core import RuntimeContext
 from predictionio_tpu.data.event import format_time
+from predictionio_tpu.obs import MetricsRegistry
 from predictionio_tpu.utils.http import (
     HTTPServerBase, Request, Response,
 )
@@ -32,9 +34,10 @@ class DashboardConfig:
 
 class Dashboard(HTTPServerBase):
     def __init__(self, config: DashboardConfig, registry=None,
-                 ssl_context=None):
+                 ssl_context=None,
+                 metrics: Optional[MetricsRegistry] = None):
         super().__init__(host=config.ip, port=config.port,
-                         ssl_context=ssl_context)
+                         ssl_context=ssl_context, metrics=metrics)
         from predictionio_tpu.utils.security import KeyAuthentication
         self.auth = KeyAuthentication(config.server_key or None)
         self.ctx = RuntimeContext(registry=registry)
@@ -62,9 +65,17 @@ class Dashboard(HTTPServerBase):
                 "<body><h1>Completed evaluations</h1>"
                 "<table border=1><tr><th>Instance</th><th>Started</th>"
                 "<th>Evaluation</th><th>Result</th></tr>"
-                + "".join(rows) + "</table></body></html>")
+                + "".join(rows) + "</table>"
+                "<p><a href='/metrics.html'>Live metrics</a></p>"
+                "</body></html>")
             return Response(status=200, body=body, content_type="text/html",
                             headers=CORS_HEADERS)
+
+        @r.get("/metrics.html")
+        def metrics_html(req: Request) -> Response:
+            self.auth.check(req)
+            return Response(status=200, body=_metrics_page(self.metrics),
+                            content_type="text/html", headers=CORS_HEADERS)
 
         # the .json route must be registered first: routes match in order
         # and the plain <iid> capture would swallow "<id>.json"
@@ -92,3 +103,32 @@ class Dashboard(HTTPServerBase):
                 "</body></html>")
             return Response(status=200, body=body, content_type="text/html",
                             headers=CORS_HEADERS)
+
+
+def _metrics_page(metrics: MetricsRegistry) -> str:
+    """Registry snapshot as an auto-refreshing HTML table: counters and
+    gauges show their value, histograms show count/sum and the estimated
+    p50/p90/p99 (the same numbers /metrics exposes to a scraper)."""
+    rows = []
+    for name, fam in sorted(metrics.snapshot().items()):
+        for s in fam["series"]:
+            labels = ",".join(f"{k}={v}" for k, v in sorted(
+                s["labels"].items()))
+            if fam["type"] == "histogram":
+                val = (f"count={s['count']} sum={s['sum']:.6g} "
+                       f"p50={s['p50']:.6g} p90={s['p90']:.6g} "
+                       f"p99={s['p99']:.6g}")
+            else:
+                val = f"{s['value']:.6g}"
+            rows.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td>{html.escape(labels)}</td>"
+                f"<td>{html.escape(fam['type'])}</td>"
+                f"<td>{html.escape(val)}</td></tr>")
+    return (
+        "<html><head><title>Metrics</title>"
+        "<meta http-equiv='refresh' content='5'></head>"
+        "<body><h1>Live metrics</h1>"
+        "<p>Prometheus text format: <a href='/metrics'>/metrics</a></p>"
+        "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
+        "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")

@@ -13,6 +13,10 @@ Checks, per source file:
   - no mutable default arguments
   - no unused imports (module scope; ``__init__.py`` re-export files
     are exempt, matching their role as a public surface)
+  - instrumented layers (serving/, data/, core/) must not use bare
+    ``print(`` or naked ``time.time()`` — telemetry goes through
+    predictionio_tpu.obs (structured logs, histograms) so it is
+    scrapable and request-correlated instead of lost on stdout
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -33,6 +37,10 @@ EXEMPT: Tuple[str, ...] = ()
 
 _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
             ast.SetComp)
+
+# layers whose telemetry must flow through predictionio_tpu.obs
+_OBS_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
+             "predictionio_tpu/core/")
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -130,6 +138,35 @@ def _check_lines(text: str, rel: str) -> Iterator[str]:
             yield f"{rel}:{n}: line length {len(stripped)} > {MAX_LINE}"
 
 
+def _check_instrumentation(tree: ast.AST, text: str,
+                           rel: str) -> Iterator[str]:
+    """In serving/, data/, core/: no bare print(), no naked time.time().
+    ``# lint: ok`` on the line is the escape hatch for legitimate
+    wall-clock uses (TTL comparisons, backoff sleeps computing deadlines).
+    """
+    if not rel.startswith(_OBS_DIRS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            yield (f"{rel}:{node.lineno}: bare print() in an "
+                   "instrumented layer; use predictionio_tpu.obs "
+                   "structured logging")
+        elif isinstance(fn, ast.Attribute) and fn.attr == "time" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            yield (f"{rel}:{node.lineno}: naked time.time() timing; "
+                   "use a predictionio_tpu.obs histogram timer "
+                   "(perf_counter inside) or mark '# lint: ok' for "
+                   "legitimate wall-clock use")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -146,6 +183,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_defaults(tree, rel))
     out.extend(_check_excepts(tree, rel))
     out.extend(_check_lines(text, rel))
+    out.extend(_check_instrumentation(tree, text, rel))
     return out
 
 

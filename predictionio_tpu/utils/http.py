@@ -10,6 +10,14 @@ the framework dependency-free.
 Features: method+path-pattern routing with `<name>` captures, JSON
 request/response helpers, query params, per-request context, graceful
 shutdown, optional TLS via an ssl context.
+
+Observability middleware (predictionio_tpu.obs): every request gets a
+request id (X-Request-ID in, generated otherwise; always echoed back),
+one structured JSON log line (method, path, route, status, duration_ms,
+request_id), a route/method/status counter and a per-route latency
+histogram; every server serves its registry on `GET /metrics` in
+Prometheus text format. Unhandled handler errors are logged structured
+with the request id instead of a bare traceback print.
 """
 
 from __future__ import annotations
@@ -19,11 +27,16 @@ import re
 import ssl as ssl_module
 import threading
 import time
-import traceback
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, List, Mapping, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
+
+from predictionio_tpu.obs import (
+    MetricsRegistry, get_logger, get_registry, new_request_id,
+)
+
+_log = get_logger("http")
 
 
 @dataclass
@@ -35,6 +48,8 @@ class Request:
     body: bytes
     params: Mapping[str, str] = field(default_factory=dict)  # path captures
     client: str = ""
+    request_id: str = ""       # assigned by the middleware, never empty there
+    route: str = ""            # matched route pattern (metrics label)
 
     def json(self) -> Any:
         if not self.body:
@@ -97,11 +112,12 @@ def _compile(pattern: str) -> re.Pattern:
 
 class Router:
     def __init__(self):
-        self.routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self.routes: List[Tuple[str, str, re.Pattern, Handler]] = []
 
     def route(self, method: str, pattern: str):
         def deco(fn: Handler) -> Handler:
-            self.routes.append((method.upper(), _compile(pattern), fn))
+            self.routes.append(
+                (method.upper(), pattern, _compile(pattern), fn))
             return fn
         return deco
 
@@ -116,7 +132,7 @@ class Router:
 
     def dispatch(self, req: Request) -> Response:
         path_matched = False
-        for method, regex, fn in self.routes:
+        for method, pattern, regex, fn in self.routes:
             m = regex.match(req.path)
             if m:
                 path_matched = True
@@ -124,6 +140,7 @@ class Router:
                     # captures are matched against the raw (still-encoded)
                     # path, then decoded individually — decoding first would
                     # let %2F alter routing and make such ids unreachable
+                    req.route = pattern
                     req.params = {k: unquote(v)
                                   for k, v in m.groupdict().items()}
                     try:
@@ -132,8 +149,11 @@ class Router:
                         return Response.json({"message": e.message}, e.status)
                     except ValueError as e:
                         return Response.json({"message": str(e)}, 400)
-                    except Exception as e:  # pragma: no cover - defensive
-                        traceback.print_exc()
+                    except Exception as e:
+                        _log.exception(
+                            "unhandled_error", request_id=req.request_id,
+                            method=req.method, path=req.path,
+                            error=f"{type(e).__name__}: {e}")
                         return Response.json({"message": f"{e}"}, 500)
         if path_matched:
             return Response.json({"message": "Method Not Allowed"}, 405)
@@ -149,7 +169,8 @@ class HTTPServerBase:
     """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 ssl_context: Optional[ssl_module.SSLContext] = None):
+                 ssl_context: Optional[ssl_module.SSLContext] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         self.host = host
         self.port = port
         self.router = Router()
@@ -157,6 +178,33 @@ class HTTPServerBase:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._lifecycle_lock = threading.Lock()
+        # one process-default registry unless a test passes its own, so a
+        # single /metrics scrape sees every server in the process
+        self.metrics = metrics if metrics is not None else get_registry()
+        self.obs_log = get_logger(type(self).__name__)
+        self._req_counter = self.metrics.counter(
+            "pio_http_requests_total", "HTTP requests served",
+            labels=("route", "method", "status"))
+        self._req_hist = self.metrics.histogram(
+            "pio_http_request_duration_seconds",
+            "HTTP request wall time by matched route", labels=("route",))
+        self.router.get("/metrics")(self._metrics_endpoint)
+
+    def _metrics_endpoint(self, req: Request) -> Response:
+        return Response.text(
+            self.metrics.render(),
+            content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    def _observe_request(self, req: Request, resp: Response,
+                         duration: float) -> None:
+        route = req.route or "(unmatched)"
+        self._req_counter.labels(
+            route=route, method=req.method, status=str(resp.status)).inc()
+        self._req_hist.labels(route=route).observe(duration)
+        self.obs_log.info(
+            "request", request_id=req.request_id, method=req.method,
+            path=req.path, route=route, status=resp.status,
+            duration_ms=round(duration * 1000.0, 3))
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, background: bool = True) -> int:
@@ -172,11 +220,16 @@ class HTTPServerBase:
                 query = {k: v[0] for k, v in raw_q.items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                rid = self.headers.get("X-Request-ID") or new_request_id()
                 req = Request(
                     method=self.command, path=parsed.path, query=query,
                     headers={k: v for k, v in self.headers.items()},
-                    body=body, client=self.client_address[0])
+                    body=body, client=self.client_address[0],
+                    request_id=rid)
+                started = time.perf_counter()
                 resp = router.dispatch(req)
+                server_ref._observe_request(
+                    req, resp, time.perf_counter() - started)
                 payload = resp.body
                 if isinstance(payload, bytes):
                     data = payload
@@ -187,6 +240,7 @@ class HTTPServerBase:
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
                 self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Request-ID", rid)
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
                 self.end_headers()

@@ -188,6 +188,30 @@ class TestStatsAndPlugins:
         assert body["currentHour"][0]["event"] == "view"
         assert body["currentHour"][0]["count"] == 1
 
+    def test_stats_buckets_are_pruned(self):
+        # regression: bookkeeping used to accumulate hourly buckets
+        # forever; anything older than PRUNE_AFTER_SECONDS must be
+        # dropped once a newer hour starts
+        from datetime import timedelta
+
+        from predictionio_tpu.data.event import Event, utcnow
+        from predictionio_tpu.data.stats import PRUNE_AFTER_SECONDS, Stats
+
+        stats = Stats()
+        ev = Event(event="view", entity_type="user", entity_id="u1")
+        now = utcnow()
+        stats.bookkeeping(1, 201, ev, now=now - timedelta(hours=5))
+        stats.bookkeeping(1, 201, ev, now=now - timedelta(hours=4))
+        assert len(stats._counts) == 2          # nothing newer yet
+        stats.bookkeeping(1, 201, ev, now=now)
+        buckets = {k[1] for k in stats._counts}
+        cutoff = max(buckets) - PRUNE_AFTER_SECONDS
+        assert all(b > cutoff for b in buckets)
+        assert len(stats._counts) == 1          # only the current hour
+        # the reachable snapshots still work after pruning
+        snap = stats.get_stats(1, now=now)
+        assert snap["currentHour"][0]["count"] == 1
+
     def test_encoded_event_id_roundtrip(self, server):
         from urllib.parse import quote
         e = dict(EV, eventId="id with space")

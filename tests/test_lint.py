@@ -38,3 +38,44 @@ def test_string_annotations_count_as_usage(tmp_path):
         "    return None\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_instrumentation_gate_catches_print_and_time(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import time\n"
+        "def f():\n"
+        "    print('served')\n"
+        "    t0 = time.time()\n"
+        "    return t0\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "bare print()" in kinds
+    assert "naked time.time()" in kinds
+
+
+def test_instrumentation_gate_scoped_to_obs_layers(tmp_path):
+    # cli/ and tools/ are operator-facing: print is their output channel
+    ok = tmp_path / "predictionio_tpu" / "cli" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import time\n"
+        "def f():\n"
+        "    print(time.time())\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_instrumentation_gate_line_escape(tmp_path):
+    f = tmp_path / "predictionio_tpu" / "data" / "ttl.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        '"""doc"""\n'
+        "import time\n"
+        "def fresh(mtime, ttl):\n"
+        "    return time.time() - mtime < ttl  # lint: ok\n"
+    )
+    assert not lint.run(tmp_path)

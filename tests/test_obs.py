@@ -1,0 +1,448 @@
+"""Observability layer tests: metrics registry semantics, Prometheus
+exposition, structured request logging with request-id propagation, the
+JAX compile probe, and /metrics end-to-end on every server (event server,
+prediction server, dashboard) plus the `pio train` phase-timing report.
+"""
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import sample_engine as se
+from predictionio_tpu.core import CoreWorkflow, Engine, EngineParams
+from predictionio_tpu.core import RuntimeContext
+from predictionio_tpu.obs import (
+    MetricsRegistry, get_logger, install_compile_probe, compile_count,
+    record_train_phases, train_report,
+)
+from predictionio_tpu.serving import PredictionServer, ServerConfig
+from predictionio_tpu.utils.http import HTTPServerBase, Response
+
+
+# -- helpers ----------------------------------------------------------------
+
+def http_get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode()
+
+
+def http_post(port, path, body, headers=None):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=data, method="POST",
+                                 headers=headers or {})
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def parse_metrics(text):
+    """Prometheus text -> {'name{labels}': float} (comments dropped)."""
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        series[key] = float(value)
+    return series
+
+
+def _sample_engine() -> Engine:
+    return Engine(
+        data_source={"": se.SDataSource},
+        preparator=se.SPreparator,
+        algorithms={"algo": se.SAlgo},
+        serving={"": se.SServing},
+    )
+
+
+def _sample_params() -> EngineParams:
+    return EngineParams(
+        data_source_params=("", se.SDataSourceParams(id=7)),
+        preparator_params=("", se.SPreparatorParams(id=8)),
+        algorithm_params_list=(("algo", se.SAlgoParams(id=9)),),
+        serving_params=("", se.SServingParams()),
+    )
+
+
+# -- registry semantics -----------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_and_gauge(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help", labels=("kind",))
+        c.labels(kind="a").inc()
+        c.labels(kind="a").inc(2)
+        c.labels(kind="b").inc()
+        assert c.labels(kind="a").value == 3
+        assert c.labels(kind="b").value == 1
+        with pytest.raises(ValueError):
+            c.labels(kind="a").inc(-1)
+        g = reg.gauge("g")
+        g.set(5)
+        g.dec(2)
+        assert g.value == 3
+
+    def test_get_or_create_is_idempotent_and_typed(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "h")
+        assert reg.counter("x_total") is a
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+        reg.histogram("h_seconds", labels=("stage",))
+        with pytest.raises(ValueError, match="labels"):
+            reg.histogram("h_seconds", labels=("other",))
+
+    def test_histogram_quantiles_on_known_data(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("u", buckets=[10, 20, 30, 40, 50,
+                                        60, 70, 80, 90, 100])
+        for v in range(1, 101):       # uniform 1..100
+            h.observe(float(v))
+        assert h.quantile(0.50) == pytest.approx(50.0)
+        assert h.quantile(0.90) == pytest.approx(90.0)
+        assert h.quantile(0.99) == pytest.approx(99.0)
+        # beyond the last finite bound clamps to it
+        h2 = reg.histogram("v", buckets=[1.0])
+        h2.observe(100.0)
+        assert h2.quantile(0.99) == 1.0
+        # empty histogram reports 0
+        h3 = reg.histogram("w", buckets=[1.0])
+        assert h3.quantile(0.5) == 0.0
+
+    def test_histogram_timer(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_seconds")
+        with h.labels().time():
+            pass
+        snap = reg.snapshot()["t_seconds"]["series"][0]
+        assert snap["count"] == 1 and snap["sum"] >= 0.0
+
+    def test_concurrent_increments_lose_nothing(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n_total", labels=("t",))
+        h = reg.histogram("n_seconds", buckets=[0.5, 1.0])
+
+        def work():
+            child = c.labels(t="x")
+            for _ in range(1000):
+                child.inc()
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.labels(t="x").value == 8000
+        snap = reg.snapshot()["n_seconds"]["series"][0]
+        assert snap["count"] == 8000
+        assert snap["sum"] == pytest.approx(2000.0)
+
+
+class TestExposition:
+    def test_render_parses_and_buckets_are_cumulative(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req_total", "requests", labels=("route",))
+        c.labels(route="/a").inc(2)
+        h = reg.histogram("lat_seconds", "latency", buckets=[1.0, 2.0])
+        for v in (0.5, 1.5, 5.0):
+            h.observe(v)
+        text = reg.render()
+        assert "# HELP req_total requests" in text
+        assert "# TYPE req_total counter" in text
+        assert "# TYPE lat_seconds histogram" in text
+        series = parse_metrics(text)
+        assert series['req_total{route="/a"}'] == 2
+        assert series['lat_seconds_bucket{le="1"}'] == 1
+        assert series['lat_seconds_bucket{le="2"}'] == 2
+        assert series['lat_seconds_bucket{le="+Inf"}'] == 3
+        assert series["lat_seconds_sum"] == pytest.approx(7.0)
+        assert series["lat_seconds_count"] == 3
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", labels=("v",))
+        c.labels(v='a"b\\c\nd').inc()
+        line = [ln for ln in reg.render().splitlines()
+                if ln.startswith("esc_total{")][0]
+        assert line == 'esc_total{v="a\\"b\\\\c\\nd"} 1'
+
+
+# -- HTTP middleware --------------------------------------------------------
+
+@pytest.fixture()
+def bare_server():
+    srv = HTTPServerBase(host="127.0.0.1", metrics=MetricsRegistry())
+
+    @srv.router.get("/ping")
+    def ping(req):
+        return Response.json({"ok": True})
+
+    @srv.router.get("/boom")
+    def boom(req):
+        raise RuntimeError("kapow")
+
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+class TestHTTPMiddleware:
+    def test_request_id_echoed_and_generated(self, bare_server):
+        _, headers, _ = http_get(bare_server.port, "/ping",
+                                 {"X-Request-ID": "client-rid-1"})
+        assert headers["X-Request-ID"] == "client-rid-1"
+        _, headers, _ = http_get(bare_server.port, "/ping")
+        rid = headers["X-Request-ID"]
+        assert len(rid) == 16 and all(c in "0123456789abcdef" for c in rid)
+
+    def test_structured_request_log(self, bare_server, caplog):
+        with caplog.at_level(logging.INFO, logger="pio.obs"):
+            http_get(bare_server.port, "/ping",
+                     {"X-Request-ID": "ridlog1"})
+        recs = [json.loads(r.getMessage()) for r in caplog.records]
+        line = [r for r in recs if r.get("event") == "request"
+                and r.get("request_id") == "ridlog1"][0]
+        assert line["method"] == "GET"
+        assert line["path"] == "/ping"
+        assert line["route"] == "/ping"
+        assert line["status"] == 200
+        assert line["duration_ms"] >= 0.0
+        assert line["level"] == "info"
+        assert "ts" in line and "component" in line
+
+    def test_500_carries_request_id_and_traceback(self, bare_server,
+                                                  caplog):
+        with caplog.at_level(logging.INFO, logger="pio.obs"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_get(bare_server.port, "/boom",
+                         {"X-Request-ID": "boomrid1"})
+        assert ei.value.code == 500
+        assert ei.value.headers["X-Request-ID"] == "boomrid1"
+        recs = [json.loads(r.getMessage()) for r in caplog.records]
+        err = [r for r in recs
+               if r.get("event") == "unhandled_error"][0]
+        assert err["request_id"] == "boomrid1"
+        assert "RuntimeError" in err["error"]
+        assert "RuntimeError: kapow" in err["traceback"]
+
+    def test_metrics_endpoint_counts_requests(self, bare_server):
+        http_get(bare_server.port, "/ping")
+        try:
+            http_get(bare_server.port, "/nope")
+        except urllib.error.HTTPError:
+            pass
+        status, headers, text = http_get(bare_server.port, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        series = parse_metrics(text)
+        key = ('pio_http_requests_total{route="/ping",method="GET",'
+               'status="200"}')
+        assert series[key] >= 1
+        unmatched = ('pio_http_requests_total{route="(unmatched)",'
+                     'method="GET",status="404"}')
+        assert series[unmatched] >= 1
+        assert series['pio_http_request_duration_seconds_count'
+                      '{route="/ping"}'] >= 1
+
+
+# -- serve-chain instrumentation end-to-end ---------------------------------
+
+@pytest.fixture()
+def sample_deploy(mem_registry):
+    """A trained sample engine + a factory for instrumented servers."""
+    engine = _sample_engine()
+    ctx = RuntimeContext(registry=mem_registry)
+    CoreWorkflow.run_train(engine, _sample_params(), ctx)
+
+    servers = []
+
+    def deploy(**cfg):
+        config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+        srv = PredictionServer(config, registry=mem_registry,
+                               engine=engine, metrics=MetricsRegistry())
+        srv.start()
+        servers.append(srv)
+        return srv
+
+    yield deploy
+    for srv in servers:
+        srv.shutdown()
+
+
+class TestServeChainMetrics:
+    def test_per_stage_histograms_after_query(self, sample_deploy):
+        srv = sample_deploy()
+        status, body = http_post(srv.port, "/queries.json", {"q": 1})
+        assert status == 200 and body["algo_id"] == 9
+        _, _, text = http_get(srv.port, "/metrics")
+        series = parse_metrics(text)
+        for stage in ("extract", "supplement", "predict", "serve"):
+            key = f'pio_serve_stage_seconds_count{{stage="{stage}"}}'
+            assert series[key] >= 1, f"missing stage {stage}: {key}"
+        algo_key = ('pio_serve_algo_predict_seconds_count'
+                    '{algo="0:SAlgo"}')
+        assert series[algo_key] >= 1
+        req_key = ('pio_http_requests_total{route="/queries.json",'
+                   'method="POST",status="200"}')
+        assert series[req_key] == 1
+
+    def test_batcher_metrics(self, sample_deploy):
+        srv = sample_deploy(batch_window_ms=5, batch_max=8)
+        results = []
+
+        def one():
+            results.append(http_post(srv.port, "/queries.json", {"q": 2}))
+
+        threads = [threading.Thread(target=one) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(s == 200 for s, _ in results)
+        _, _, text = http_get(srv.port, "/metrics")
+        series = parse_metrics(text)
+        assert series["pio_serve_batch_size_count"] >= 1
+        assert series["pio_serve_batch_size_sum"] == 4
+        assert series["pio_serve_batch_queue_depth"] == 0
+
+
+# -- event server + dashboard /metrics --------------------------------------
+
+class TestEventServerMetrics:
+    def test_ingest_counters_and_payload_histogram(self, mem_registry):
+        from predictionio_tpu.data.eventserver import (
+            EventServer, EventServerConfig,
+        )
+        from predictionio_tpu.data.storage import AccessKey, App
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "obsapp"))
+        mem_registry.get_meta_data_access_keys().insert(
+            AccessKey("OKEY", app_id, ()))
+        mem_registry.get_events().init(app_id)
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          mem_registry, metrics=MetricsRegistry())
+        srv.start()
+        try:
+            ev = {"event": "view", "entityType": "u", "entityId": "1"}
+            status, _ = http_post(srv.port, "/events.json?accessKey=OKEY",
+                                  ev)
+            assert status == 201
+            status, _ = http_post(
+                srv.port, "/batch/events.json?accessKey=OKEY", [ev, ev])
+            assert status == 200
+            _, _, text = http_get(srv.port, "/metrics")
+            series = parse_metrics(text)
+            assert series['pio_events_ingested_total{via="single"}'] == 1
+            assert series['pio_events_ingested_total{via="batch"}'] == 2
+            assert series["pio_ingest_payload_bytes_count"] == 2
+            assert series["pio_ingest_payload_bytes_sum"] > 0
+        finally:
+            srv.shutdown()
+
+
+class TestDashboardMetrics:
+    def test_metrics_and_snapshot_page(self, mem_registry):
+        from predictionio_tpu.tools.dashboard import (
+            Dashboard, DashboardConfig,
+        )
+        reg = MetricsRegistry()
+        reg.counter("custom_total", "c").inc(3)
+        reg.histogram("custom_seconds").observe(0.01)
+        srv = Dashboard(DashboardConfig(ip="127.0.0.1", port=0),
+                        registry=mem_registry, metrics=reg)
+        srv.start()
+        try:
+            status, _, text = http_get(srv.port, "/metrics")
+            assert status == 200
+            assert parse_metrics(text)["custom_total"] == 3
+            status, _, page = http_get(srv.port, "/metrics.html")
+            assert status == 200
+            assert "Live metrics" in page
+            assert "custom_total" in page
+            assert "custom_seconds" in page and "p99" in page
+            _, _, index = http_get(srv.port, "/")
+            assert "/metrics.html" in index
+        finally:
+            srv.shutdown()
+
+
+# -- train-phase report + compile probe -------------------------------------
+
+class TestTrainReport:
+    def test_record_and_report(self):
+        reg = MetricsRegistry()
+        record_train_phases(
+            {"read_s": 0.5, "prepare_s": 0.25, "train_algo0_s": 1.0},
+            registry=reg)
+        snap = reg.snapshot()["pio_train_phase_seconds"]
+        phases = {s["labels"]["phase"]: s for s in snap["series"]}
+        assert phases["read"]["sum"] == pytest.approx(0.5)
+        assert phases["train_algo0"]["count"] == 1
+        report = train_report(registry=reg)
+        assert "Training phase report" in report
+        assert "read" in report and "train_algo0" in report
+
+    def test_empty_report(self):
+        reg = MetricsRegistry()
+        assert "(no training phases recorded)" in train_report(registry=reg)
+
+    def test_compile_probe_counts_a_fresh_jit(self):
+        import jax
+        import jax.numpy as jnp
+        install_compile_probe()
+        before = compile_count()
+        jax.jit(lambda x: x * 2.0 + 1.0)(jnp.arange(8.0))
+        assert compile_count() >= before + 1
+
+    def test_cli_train_prints_phase_report(self, mem_registry, tmp_path,
+                                           capsys):
+        from predictionio_tpu.cli.main import main
+        from predictionio_tpu.core.workflow import register_engine
+        register_engine("sample_obs", _sample_engine)
+        ej = tmp_path / "engine.json"
+        ej.write_text(json.dumps({
+            "id": "default", "engineFactory": "sample_obs",
+            "datasource": {"params": {"id": 7}},
+            "algorithms": [{"name": "algo", "params": {"id": 9}}],
+        }))
+        rc = main(["train", "--engine-json", str(ej)])
+        out = capsys.readouterr()
+        assert rc == 0
+        result = json.loads(out.out)   # stdout stays pure JSON
+        assert "COMPLETED" in str(result["status"])
+        assert result["jaxCompiles"] >= 0
+        assert "Training phase report" in out.err
+        assert "read" in out.err and "train_algo0" in out.err
+
+
+class TestStructuredLogger:
+    def test_every_line_is_one_json_object(self, caplog):
+        log = get_logger("testcomp")
+        with caplog.at_level(logging.INFO, logger="pio.obs"):
+            log.info("hello", a=1, b="x")
+            log.warning("careful", why="because")
+        lines = [json.loads(r.getMessage()) for r in caplog.records]
+        hello = [r for r in lines if r["event"] == "hello"][0]
+        assert hello["component"] == "testcomp"
+        assert hello["a"] == 1 and hello["b"] == "x"
+        warn = [r for r in lines if r["event"] == "careful"][0]
+        assert warn["level"] == "warning"
+
+    def test_exception_captures_traceback(self, caplog):
+        log = get_logger("testcomp2")
+        with caplog.at_level(logging.INFO, logger="pio.obs"):
+            try:
+                raise ValueError("nope")
+            except ValueError:
+                log.exception("it_broke", detail="d")
+        rec = [json.loads(r.getMessage()) for r in caplog.records
+               if "it_broke" in r.getMessage()][0]
+        assert rec["level"] == "error"
+        assert "ValueError: nope" in rec["traceback"]
