@@ -160,6 +160,8 @@ class CoreWorkflow:
         if engine_params is None:
             engine_params = engine_params_from_instance(engine, instance)
         from predictionio_tpu.core.engine import bind_serving_context
+        from predictionio_tpu.resilience import faults
+        faults().check("deploy.prepare")  # chaos seam: /reload rollback
         ds, prep, algos, serving = engine.make_components(engine_params)
         bind_serving_context(algos, ctx)
         blob_row = ctx.registry.get_model_data_models().get(instance.id)

@@ -4,6 +4,7 @@ Parity: reference `data/.../api/EventServer.scala:54-663` — all routes,
 status codes, auth and error messages:
 
   GET    /                      -> {"status": "alive"}
+  GET    /health, /ready        -> liveness / readiness (utils.http base)
   GET    /plugins.json          -> plugin descriptions
   GET    /plugins/<type>/<name>/... -> plugin REST handler
   POST   /events.json           -> 201 {"eventId": id}
@@ -54,6 +55,10 @@ class EventServerConfig:
     port: int = 7070
     plugins: Sequence[EventServerPlugin] = ()
     stats: bool = False
+    # resilience knobs: default per-request deadline (0 = unbounded) and
+    # in-flight admission cap (0 = unlimited; excess sheds with 429)
+    default_deadline_ms: int = 0
+    max_inflight: int = 0
 
 
 @dataclass(frozen=True)
@@ -69,7 +74,9 @@ class EventServer(HTTPServerBase):
                  metrics: Optional[MetricsRegistry] = None):
         self.config = config or EventServerConfig()
         super().__init__(host=self.config.ip, port=self.config.port,
-                         metrics=metrics)
+                         metrics=metrics,
+                         default_deadline_ms=self.config.default_deadline_ms,
+                         max_inflight=self.config.max_inflight)
         self.registry = registry or storage()
         self.event_client = self.registry.get_events()
         self.access_keys_client = self.registry.get_meta_data_access_keys()
@@ -85,6 +92,15 @@ class EventServer(HTTPServerBase):
             "Ingest request payload size in bytes",
             buckets=PAYLOAD_BUCKETS)
         self._install_routes()
+
+    # -- readiness ----------------------------------------------------------
+    def readiness(self):
+        """Ready = no storage circuit breaker is open (an open breaker
+        means ingests would fast-fail 503; tell the LB to back off)."""
+        states = self.registry.breaker_states()
+        open_breakers = sorted(
+            n for n, s in states.items() if s == "open")
+        return not open_breakers, {"storageBreakers": states}
 
     # -- auth ---------------------------------------------------------------
     def _auth(self, req: Request) -> AuthData:

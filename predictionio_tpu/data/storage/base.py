@@ -36,6 +36,19 @@ class StorageWriteError(StorageError):
     """A write rejected by the backend (duplicate key, constraint violation)."""
 
 
+class StorageUnavailableError(StorageError):
+    """A transient backend failure (connection refused, timeout, flaky
+    remote). Drivers raise this — or a plain OSError — for conditions a
+    retry can cure; the resilience proxy (`resilient.py`) retries these
+    and trips the source's circuit breaker when they persist. Client
+    errors (StorageWriteError, bad params) must NOT use this type."""
+
+
+# what the storage resilience layer treats as retryable / breaker-tripping
+# (ConnectionError and TimeoutError are OSError subclasses)
+TRANSIENT_STORAGE_ERRORS = (StorageUnavailableError, OSError)
+
+
 # ---------------------------------------------------------------------------
 # Meta data records
 # ---------------------------------------------------------------------------

@@ -23,7 +23,11 @@ from pathlib import Path
 from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.data.storage.base import (
+    StorageError, TRANSIENT_STORAGE_ERRORS,
+)
+from predictionio_tpu.data.storage.resilient import ResilientDAO
+from predictionio_tpu.resilience import CircuitBreaker, RetryPolicy
 
 
 # type name -> (client factory, {dao role -> DAO class name on module})
@@ -145,6 +149,7 @@ class StorageRegistry:
         self._lock = threading.RLock()
         self._clients: Dict[str, object] = {}
         self._daos: Dict[Tuple[str, str], object] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
         self.sources, self.repositories = self._parse(self.config)
 
     @staticmethod
@@ -204,7 +209,10 @@ class StorageRegistry:
             return self._clients[source_name]
 
     def get_data_object(self, source_name: str, dao: str):
-        """Parity: Storage.getDataObject (Storage.scala:308-357)."""
+        """Parity: Storage.getDataObject (Storage.scala:308-357). The
+        returned DAO is wrapped in the resilience proxy (retry + per-
+        source circuit breaker + chaos seams) unless the source sets
+        `PIO_STORAGE_SOURCES_<N>_RESILIENCE=off`."""
         with self._lock:
             key = (source_name, dao)
             if key not in self._daos:
@@ -214,8 +222,44 @@ class StorageRegistry:
                     raise StorageError(
                         f"Storage type {scfg['TYPE']} does not support "
                         f"data object {dao}")
-                self._daos[key] = driver["daos"][dao](self._client(source_name))
+                raw = driver["daos"][dao](self._client(source_name))
+                self._daos[key] = self._wrap_resilient(
+                    raw, source_name, dao, scfg)
             return self._daos[key]
+
+    def _wrap_resilient(self, dao: object, source: str, dao_name: str,
+                        scfg: Mapping[str, str]):
+        """Per-source resilience knobs (all optional, via
+        PIO_STORAGE_SOURCES_<N>_*): RESILIENCE=off disables wrapping;
+        RETRY_ATTEMPTS / RETRY_BASE_DELAY tune the retry schedule;
+        BREAKER_THRESHOLD / BREAKER_RECOVERY_S tune the breaker."""
+        if str(scfg.get("RESILIENCE", "on")).lower() in (
+                "off", "0", "false", "no"):
+            return dao
+        policy = RetryPolicy(
+            attempts=int(scfg.get("RETRY_ATTEMPTS", 3)),
+            base_delay=float(scfg.get("RETRY_BASE_DELAY", 0.05)),
+            retryable=TRANSIENT_STORAGE_ERRORS)
+        return ResilientDAO(
+            dao, seam=f"storage.{source}.{dao_name}", source=source,
+            breaker=self._breaker(source, scfg), policy=policy)
+
+    def _breaker(self, source: str, scfg: Mapping[str, str]) -> CircuitBreaker:
+        breaker = self._breakers.get(source)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                f"storage.{source}",
+                failure_threshold=int(scfg.get("BREAKER_THRESHOLD", 5)),
+                recovery_time=float(scfg.get("BREAKER_RECOVERY_S", 30.0)))
+            self._breakers[source] = breaker
+        return breaker
+
+    def breaker_states(self) -> Dict[str, str]:
+        """Current breaker state per active source ('closed' / 'open' /
+        'half-open'); feeds every server's /ready endpoint."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {name: b.state for name, b in breakers.items()}
 
     def _repo_dao(self, repo: str, dao: str):
         return self.get_data_object(self.repositories[repo]["SOURCE"], dao)

@@ -278,6 +278,20 @@ class MetricsRegistry:
         with self._lock:
             return list(self._families.values())
 
+    def value(self, name: str, **labels) -> float:
+        """Read one counter/gauge series without creating it: returns 0.0
+        when the family or labelset does not exist yet (reading a metric
+        must never mutate the registry — chaos tests and /ready assert
+        on series that only appear after the first failure)."""
+        with self._lock:
+            fam = self._families.get(name)
+        if fam is None or isinstance(fam, Histogram):
+            return 0.0
+        key = tuple(str(labels.get(n, "")) for n in fam.labelnames)
+        with fam._lock:
+            child = fam._children.get(key)
+            return child._value if child is not None else 0.0
+
     # -- exposition ---------------------------------------------------------
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""

@@ -1,0 +1,36 @@
+"""Resilience layer: deadlines, retry, circuit breaking, load shedding,
+fault injection.
+
+The spray/akka reference gets supervision, bounded mailboxes, and ask
+timeouts from its actor runtime; this package is the explicit analog
+for the stdlib-threaded stack, threaded through all three HTTP planes,
+the serve chain, and every storage backend:
+
+  deadline.py  X-PIO-Deadline-Ms propagation, 504 on expiry
+  retry.py     bounded exponential backoff + jitter, deadline-aware
+  breaker.py   half-open circuit breaker, state on /metrics and /ready
+  shed.py      bounded admission (503/429 + Retry-After), shed counters
+  faults.py    deterministic chaos harness driving the seams above
+
+Every resilience event lands in the PR-1 metrics registry
+(`pio_deadline_expired_total`, `pio_shed_total`, `pio_breaker_state`,
+`pio_storage_retries_total`, `pio_faults_injected_total`), so bending
+under load is observable, not silent.
+"""
+
+from predictionio_tpu.resilience.deadline import (  # noqa: F401
+    DEADLINE_HEADER, Deadline, DeadlineExceeded, current_deadline,
+    deadline_from_header, deadline_scope,
+)
+from predictionio_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy, call_with_retry, retry,
+)
+from predictionio_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker, CircuitOpenError,
+)
+from predictionio_tpu.resilience.shed import (  # noqa: F401
+    InflightLimiter, OverloadedError,
+)
+from predictionio_tpu.resilience.faults import (  # noqa: F401
+    FaultError, FaultInjector, FaultRule, faults,
+)

@@ -1,0 +1,120 @@
+"""Request deadlines: parsing, propagation, expiry.
+
+The reference stack gets its timeout story from spray/akka ask-timeouts
+(`CreateServer.scala`'s implicit 5s ask timeout bounds every actor
+round-trip). The stdlib-threaded reimplementation had NO bound anywhere:
+a dead drainer thread stranded `_MicroBatcher.submit` forever. This
+module is the single timeout currency for the whole stack:
+
+  - clients send `X-PIO-Deadline-Ms: <budget>` (wall budget for the
+    whole request); servers apply a configurable default otherwise
+  - the HTTP middleware parses the header into a `Deadline` and installs
+    it in a contextvar for the handler thread, so storage calls and the
+    micro-batcher see the SAME budget without parameter plumbing (the
+    deadline-propagation prerequisite the disaggregated-serving
+    literature calls out, arXiv:2210.14826 §5)
+  - expiry raises `DeadlineExceeded`, which the router maps to a 504
+    JSON response
+
+Deadlines are monotonic-clock instants, so they survive wall-clock
+adjustments and cost one `time.monotonic()` per check.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+DEADLINE_HEADER = "X-PIO-Deadline-Ms"
+
+
+class DeadlineExceeded(Exception):
+    """The request's time budget ran out (mapped to HTTP 504)."""
+
+
+class Deadline:
+    """An absolute expiry instant on the monotonic clock."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: float):
+        self.expires_at = expires_at
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(time.monotonic() + ms / 1000.0)
+
+    @classmethod
+    def after_s(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    def remaining(self) -> float:
+        """Seconds left; 0.0 once expired (never negative)."""
+        return max(0.0, self.expires_at - time.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def check(self, what: str = "request") -> None:
+        """Raise DeadlineExceeded if the budget is spent."""
+        if self.expired:
+            raise DeadlineExceeded(f"{what}: deadline exceeded")
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+def deadline_from_header(value: Optional[str],
+                         default_ms: float = 0) -> Optional[Deadline]:
+    """Build the request Deadline from the raw header value.
+
+    No header: the server default applies (0 = unbounded -> None).
+    A malformed or non-positive header raises ValueError, which the
+    HTTP layer maps to a 400 (a garbage budget must not silently become
+    an unbounded one).
+    """
+    if value is None or value == "":
+        return Deadline.after_ms(default_ms) if default_ms > 0 else None
+    try:
+        ms = float(value)
+    except ValueError:
+        raise ValueError(
+            f"Invalid {DEADLINE_HEADER} header: {value!r} "
+            "(expected milliseconds)") from None
+    if ms <= 0:
+        raise ValueError(
+            f"Invalid {DEADLINE_HEADER} header: {value!r} "
+            "(must be > 0)")
+    return Deadline.after_ms(ms)
+
+
+_current: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
+    "pio_deadline", default=None)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The deadline of the request being handled on this thread, if any."""
+    return _current.get()
+
+
+class deadline_scope:
+    """Context manager installing a deadline for the enclosed code.
+
+    The HTTP middleware wraps dispatch in one of these; retry loops and
+    storage calls consult `current_deadline()` to cap their backoff.
+    """
+
+    __slots__ = ("deadline", "_token")
+
+    def __init__(self, deadline: Optional[Deadline]):
+        self.deadline = deadline
+
+    def __enter__(self) -> Optional[Deadline]:
+        self._token = _current.set(self.deadline)
+        return self.deadline
+
+    def __exit__(self, *exc) -> bool:
+        _current.reset(self._token)
+        return False

@@ -1,0 +1,121 @@
+"""Deterministic fault-injection harness.
+
+The chaos half of the resilience layer: named seams in the stack call
+`faults().check("seam.name")`, which is a no-op (one dict read) until a
+test arms a rule. Rules inject, deterministically:
+
+  - latency (sleep before proceeding)
+  - exceptions (an instance, or a type to instantiate per hit)
+  - N-then-succeed (`times=N`: the first N hits fire, the rest pass —
+    the storage-flake shape that retry must absorb)
+
+Seams are matched by dotted-prefix: a rule armed at ``storage.PIO``
+hits ``storage.PIO.Events.insert`` and every sibling. Standard seams:
+
+  storage.<source>.<dao>.<method>   every wrapped storage DAO call
+  serve.predict.<i>:<AlgoClass>     per-algorithm device compute
+  deploy.prepare                    model load during deploy/reload
+
+Injections are counted per seam (`pio_faults_injected_total`) so a
+chaos run can assert the fault actually fired. The process-default
+injector is what the seams consult; tests arm it directly and clear it
+in teardown (`faults().clear()`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Union
+
+from predictionio_tpu.obs import get_registry
+
+
+class FaultError(Exception):
+    """Generic injected failure. Deliberately NOT an OSError subclass:
+    arm `error=OSError` when the scenario should look transient to the
+    retry/breaker machinery, `error=FaultError` when it should not."""
+
+
+class FaultRule:
+    """One armed fault; mutable hit counter, guarded by the injector."""
+
+    __slots__ = ("seam", "latency", "error", "times", "hits")
+
+    def __init__(self, seam: str, latency: float = 0.0,
+                 error: Union[BaseException, type, None] = None,
+                 times: Optional[int] = None):
+        self.seam = seam
+        self.latency = latency
+        self.error = error
+        self.times = times           # None = every hit
+        self.hits = 0
+
+    def matches(self, seam: str) -> bool:
+        return seam == self.seam or seam.startswith(self.seam + ".") \
+            or seam.startswith(self.seam + ":")
+
+    def exhausted(self) -> bool:
+        return self.times is not None and self.hits >= self.times
+
+
+class FaultInjector:
+    """Holds armed rules; `check` is the seam entry point."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self._counter = None
+
+    def arm(self, seam: str, *, latency: float = 0.0,
+            error: Union[BaseException, type, None] = None,
+            times: Optional[int] = None) -> FaultRule:
+        """Arm a rule at `seam` (dotted-prefix matched). Returns the rule
+        so tests can inspect `rule.hits`."""
+        rule = FaultRule(seam, latency=latency, error=error, times=times)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._rules)
+
+    def check(self, seam: str) -> None:
+        """Apply every matching, non-exhausted rule at this seam."""
+        if not self._rules:      # fast path: harness disarmed
+            return
+        fired: List[FaultRule] = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.matches(seam) and not rule.exhausted():
+                    rule.hits += 1
+                    fired.append(rule)
+        for rule in fired:
+            self._count(seam)
+            if rule.latency > 0:
+                time.sleep(rule.latency)
+            if rule.error is not None:
+                err = rule.error
+                if isinstance(err, type):
+                    err = err(f"injected fault at {seam}")
+                raise err
+
+    def _count(self, seam: str) -> None:
+        if self._counter is None:
+            self._counter = get_registry().counter(
+                "pio_faults_injected_total",
+                "Faults injected by the chaos harness", labels=("seam",))
+        self._counter.labels(seam=seam).inc()
+
+
+_default = FaultInjector()
+
+
+def faults() -> FaultInjector:
+    """The process-default injector every seam consults."""
+    return _default

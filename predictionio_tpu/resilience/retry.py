@@ -1,0 +1,94 @@
+"""Bounded retry with exponential backoff and full jitter.
+
+Replaces ad-hoc `while True: try ... time.sleep(n)` loops (now forbidden
+in serving/ and data/ by the lint gate) with one policy object:
+
+  - exponential backoff (`base_delay * multiplier**attempt`, capped)
+  - full jitter (each delay scaled by a random factor in
+    [1-jitter, 1]), so synchronized clients don't stampede a recovering
+    backend
+  - an explicit retryable-exception allowlist — client errors
+    (constraint violations, bad params) must surface immediately, only
+    transient faults earn another attempt
+  - deadline awareness: when `current_deadline()` has less budget left
+    than the next backoff, the retry loop gives up and re-raises rather
+    than sleeping through the caller's 504
+
+The sleep function is injectable so tests run retry schedules in
+microseconds.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+from predictionio_tpu.resilience.deadline import current_deadline
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How many attempts, how long between them, and what qualifies."""
+
+    attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5          # delay *= uniform(1-jitter, 1)
+    retryable: Tuple[Type[BaseException], ...] = (OSError,)
+
+    def backoff(self, attempt: int,
+                rng: Callable[[], float] = random.random) -> float:
+        """Delay before retry number `attempt` (0-based), jittered."""
+        delay = min(self.max_delay,
+                    self.base_delay * (self.multiplier ** attempt))
+        return delay * (1.0 - self.jitter * rng())
+
+
+def call_with_retry(fn: Callable, *args,
+                    policy: Optional[RetryPolicy] = None,
+                    on_retry: Optional[Callable] = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    **kwargs):
+    """Run `fn`, retrying transient failures per `policy`.
+
+    `on_retry(attempt, exc, delay)` fires before each backoff sleep —
+    the hook instrumentation sites use to count retries. Non-retryable
+    exceptions propagate immediately; the final attempt's exception
+    propagates unwrapped.
+    """
+    policy = policy or RetryPolicy()
+    attempts = max(1, policy.attempts)
+    for attempt in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retryable as e:
+            if attempt == attempts - 1:
+                raise
+            delay = policy.backoff(attempt)
+            deadline = current_deadline()
+            if deadline is not None and deadline.remaining() <= delay:
+                # not enough budget to wait out the backoff: fail now so
+                # the caller's 504/fallback fires within its deadline
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def retry(policy: Optional[RetryPolicy] = None,
+          on_retry: Optional[Callable] = None,
+          sleep: Callable[[float], None] = time.sleep):
+    """Decorator form of `call_with_retry`."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retry(fn, *args, policy=policy,
+                                   on_retry=on_retry, sleep=sleep, **kwargs)
+        return wrapped
+    return deco

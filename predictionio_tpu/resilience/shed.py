@@ -1,0 +1,68 @@
+"""Load shedding: bounded admission instead of unbounded queueing.
+
+Under a burst beyond capacity, an unbounded server converts overload
+into latency for EVERYONE (queues grow, every request times out); a
+bounded one rejects the excess immediately with `Retry-After` so
+well-behaved clients back off and the requests that ARE admitted finish
+inside their deadlines. Two primitives:
+
+  - `OverloadedError`: raised at any full admission point; the HTTP
+    router maps it to its `status` (503 for server-wide saturation such
+    as a full micro-batch queue, 429 for per-plane in-flight caps) with
+    a `Retry-After` header
+  - `InflightLimiter`: a non-blocking concurrency cap for an HTTP plane
+    (`max_inflight` server knob); acquiring past the limit sheds rather
+    than queues
+
+Every shed is counted in `pio_shed_total{surface=...}` by the call
+site, so /metrics shows WHERE the system is saturating.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class OverloadedError(Exception):
+    """Admission denied: the named surface is at capacity."""
+
+    def __init__(self, message: str, *, retry_after: float = 1.0,
+                 status: int = 503):
+        super().__init__(message)
+        self.message = message
+        self.retry_after = max(0.0, retry_after)
+        self.status = status
+
+
+class InflightLimiter:
+    """Non-blocking cap on concurrent requests; 0 = unlimited."""
+
+    def __init__(self, limit: int = 0, *, surface: str = "http",
+                 retry_after: float = 1.0):
+        self.limit = max(0, limit)
+        self.surface = surface
+        self.retry_after = retry_after
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def __enter__(self) -> "InflightLimiter":
+        if self.limit:
+            with self._lock:
+                if self._inflight >= self.limit:
+                    raise OverloadedError(
+                        f"{self.surface}: {self.limit} requests already "
+                        "in flight", retry_after=self.retry_after,
+                        status=429)
+                self._inflight += 1
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self.limit:
+            with self._lock:
+                self._inflight -= 1
+        return False

@@ -11,6 +11,15 @@ blockers -> optional feedback event -> JSON. With `batch_window_ms > 0`
 concurrent requests are coalesced into one device batch through the
 algorithms' `batch_predict` (the reference's "TODO: Parallelize" answered
 with MXU batching).
+
+Resilience (predictionio_tpu.resilience): the micro-batch queue is
+BOUNDED (`queue_max`) and sheds with 503 + Retry-After when full; every
+submit waits with a timeout (request deadline, else `submit_timeout_ms`)
+so a dead drainer yields a 504, never a stranded request; one failing
+algorithm degrades the serve result instead of failing the whole query
+(unless it is the only one); /reload keeps the previous deployment
+serving when the new load fails; feedback posts retry with backoff and
+then DROP (counted) rather than block the queue forever.
 """
 
 from __future__ import annotations
@@ -28,7 +37,11 @@ from typing import Any, Dict, List, Optional, Sequence
 from predictionio_tpu.core import RuntimeContext, extract_params
 from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
-from predictionio_tpu.obs import MetricsRegistry, get_registry
+from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
+from predictionio_tpu.resilience import (
+    Deadline, DeadlineExceeded, OverloadedError, RetryPolicy,
+    call_with_retry, current_deadline, faults,
+)
 from predictionio_tpu.serving.plugins import (
     EngineServerPluginContext, QueryInfo,
 )
@@ -38,6 +51,8 @@ from predictionio_tpu.utils.http import (
 
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                       256.0, 512.0)
+
+_log = get_logger("serving")
 
 
 class _ServeInstruments:
@@ -65,6 +80,21 @@ class _ServeInstruments:
             "pio_feedback_events_total",
             "Feedback events by outcome (sent/failed/dropped)",
             labels=("outcome",))
+        self.feedback_dropped = metrics.counter(
+            "pio_feedback_dropped_total",
+            "Feedback events dropped (queue full / send retries "
+            "exhausted)", labels=("reason",))
+        self.shed = metrics.counter(
+            "pio_shed_total", "Requests shed by surface at admission",
+            labels=("surface",))
+        self.algo_errors = metrics.counter(
+            "pio_algo_errors_total",
+            "Per-algorithm predict failures isolated by graceful "
+            "degradation", labels=("algo",))
+        self.reloads = metrics.counter(
+            "pio_reload_total",
+            "Deployment (re)loads by outcome (ok/failed)",
+            labels=("outcome",))
 
 
 @dataclass
@@ -82,6 +112,21 @@ class ServerConfig:
     batch_window_ms: int = 0     # 0 = serve each request immediately
     batch_max: int = 64
     verbose: bool = False
+    # resilience knobs ----------------------------------------------------
+    # micro-batcher pending-queue cap; a full queue sheds with 503 +
+    # Retry-After instead of growing without bound
+    queue_max: int = 256
+    # default per-request deadline (ms; 0 = none) applied when the client
+    # sends no X-PIO-Deadline-Ms header
+    default_deadline_ms: int = 0
+    # hard backstop on a batched submit when no deadline applies: a dead
+    # drainer surfaces as 504 after this long, never an eternal hang
+    submit_timeout_ms: int = 30000
+    # HTTP-plane in-flight cap (0 = unlimited; excess sheds with 429)
+    max_inflight: int = 0
+    # feedback loop: queue bound, and send attempts before dropping
+    feedback_queue_max: int = 1024
+    feedback_retries: int = 3
     # Optional server key protecting /reload and /stop (the reference
     # guards both with authenticate(withAccessKeyFromFile),
     # CreateServer.scala:624-637). Sourced from PIO_SERVER_ACCESS_KEY.
@@ -123,19 +168,39 @@ class _Deployment:
 
     def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
         """supplement -> per-algo batch_predict -> serve, for a batch;
-        each stage lands in pio_serve_stage_seconds."""
+        each stage lands in pio_serve_stage_seconds.
+
+        Per-algorithm error isolation: one failing algorithm is dropped
+        from the ensemble for this batch (counted in
+        pio_algo_errors_total) and serving.serve runs on the surviving
+        predictions — a degraded answer instead of a failed query. Only
+        when EVERY algorithm fails does the batch error."""
         obs = self.obs
         with obs.stage.labels(stage="supplement").time():
             supplemented = [self.serving.supplement(q) for q in queries]
         indexed = list(enumerate(supplemented))
-        per_algo: List[Dict[int, Any]] = []
+        per_algo: List[Optional[Dict[int, Any]]] = []
+        errors: List[Exception] = []
         with obs.stage.labels(stage="predict").time():
             for i, (a, m) in enumerate(zip(self.algos, self.models)):
-                with obs.algo.labels(
-                        algo=f"{i}:{type(a).__name__}").time():
-                    per_algo.append(dict(a.batch_predict(m, indexed)))
+                label = f"{i}:{type(a).__name__}"
+                try:
+                    faults().check(f"serve.predict.{label}")
+                    with obs.algo.labels(algo=label).time():
+                        per_algo.append(dict(a.batch_predict(m, indexed)))
+                except Exception as e:
+                    errors.append(e)
+                    per_algo.append(None)
+                    obs.algo_errors.labels(algo=label).inc()
+                    _log.warning(
+                        "algo_predict_failed", algo=label,
+                        error=f"{type(e).__name__}: {e}",
+                        degraded=len(self.algos) > 1)
+        alive = [pa for pa in per_algo if pa is not None]
+        if not alive:
+            raise errors[0]
         with obs.stage.labels(stage="serve").time():
-            return [self.serving.serve(q, [pa[i] for pa in per_algo])
+            return [self.serving.serve(q, [pa[i] for pa in alive])
                     for i, q in enumerate(queries)]
 
 
@@ -155,53 +220,107 @@ class _MicroBatcher:
     design reaching full device batches after the first drain.
 
     Device compute always runs OUTSIDE the lock so a drain never stalls
-    submitters."""
+    submitters.
+
+    Resilience: the pending queue is BOUNDED (`queue_max`; full queue
+    raises OverloadedError -> 503 + Retry-After upstream) and every
+    submit waits with a TIMEOUT — the request deadline when one applies,
+    else the `submit_timeout_s` backstop — so a wedged or crashed drainer
+    turns into a 504, never a stranded handler thread. A drainer that
+    dies on an unexpected error fails every pending waiter and clears
+    the drain flag so the next submit starts a fresh one."""
 
     def __init__(self, window_s: float, batch_max: int,
-                 obs: Optional[_ServeInstruments] = None):
+                 obs: Optional[_ServeInstruments] = None,
+                 queue_max: int = 256, submit_timeout_s: float = 30.0):
         self.window_s = window_s
         self.batch_max = batch_max
+        self.queue_max = queue_max
+        self.submit_timeout_s = submit_timeout_s
         self.obs = obs if obs is not None else _ServeInstruments()
         self._lock = threading.Lock()
         # each item: (deployment, query, done event, result slot)
         self._pending: List[tuple] = []
         self._draining = False
 
-    def submit(self, deployment: _Deployment, query: Any) -> Any:
+    def submit(self, deployment: _Deployment, query: Any,
+               deadline: Optional[Deadline] = None) -> Any:
         done = threading.Event()
         slot: Dict[str, Any] = {}
+        item = (deployment, query, done, slot)
         with self._lock:
-            self._pending.append((deployment, query, done, slot))
+            if self.queue_max > 0 and len(self._pending) >= self.queue_max:
+                self.obs.shed.labels(surface="queries").inc()
+                raise OverloadedError(
+                    "micro-batch queue full",
+                    retry_after=max(self.window_s, 0.05))
+            self._pending.append(item)
             self.obs.queue_depth.set(float(len(self._pending)))
             drain = not self._draining
             if drain:
                 self._draining = True
         if drain:
             threading.Thread(target=self._drain_loop, daemon=True).start()
-        done.wait()
+        timeout = self.submit_timeout_s
+        if deadline is not None:
+            timeout = min(timeout, max(deadline.remaining(), 0.0))
+        if not done.wait(timeout):  # lint: ok — bounded by construction
+            # expired while queued (or the drainer is wedged): withdraw
+            # the item if it hasn't been taken yet, then report 504
+            with self._lock:
+                try:
+                    self._pending.remove(item)
+                    self.obs.queue_depth.set(float(len(self._pending)))
+                except ValueError:
+                    pass  # already drained; result will be discarded
+            raise DeadlineExceeded(
+                "request deadline expired in micro-batch queue"
+                if deadline is not None else
+                f"micro-batch submit timed out after "
+                f"{self.submit_timeout_s:.1f}s")
         if "error" in slot:
             raise slot["error"]
         return slot["result"]
 
     def _drain_loop(self):
-        while True:
+        batch: List[tuple] = []
+        try:
+            while True:
+                with self._lock:
+                    full = len(self._pending) >= self.batch_max
+                if not full:
+                    # only wait out the window when a full batch isn't
+                    # already queued — a formed batch ships immediately
+                    time.sleep(self.window_s)  # lint: ok — batch window
+                with self._lock:
+                    batch = self._pending[:self.batch_max]
+                    self._pending = self._pending[self.batch_max:]
+                    self.obs.queue_depth.set(float(len(self._pending)))
+                    if not batch:
+                        # nothing arrived during the window: retire. The
+                        # flag is cleared under the same lock any submit
+                        # checks, so the next arrival starts a fresh
+                        # drainer.
+                        self._draining = False
+                        return
+                self._process(batch)
+                batch = []
+        except BaseException as e:
+            # drainer crash: fail every waiter NOW — the dequeued batch
+            # and everything still pending — instead of leaving them to
+            # their timeouts, and clear the flag so the next submit
+            # spawns a healthy drainer
             with self._lock:
-                full = len(self._pending) >= self.batch_max
-            if not full:
-                # only wait out the window when a full batch isn't
-                # already queued — a formed batch ships immediately
-                time.sleep(self.window_s)
-            with self._lock:
-                batch = self._pending[:self.batch_max]
-                self._pending = self._pending[self.batch_max:]
-                self.obs.queue_depth.set(float(len(self._pending)))
-                if not batch:
-                    # nothing arrived during the window: retire. The flag
-                    # is cleared under the same lock any submit checks,
-                    # so the next arrival starts a fresh drainer.
-                    self._draining = False
-                    return
-            self._process(batch)
+                stranded = batch + self._pending
+                self._pending = []
+                self._draining = False
+                self.obs.queue_depth.set(0.0)
+            for _, _, done, slot in stranded:
+                slot["error"] = e
+                done.set()
+            _log.error("batch_drainer_crashed",
+                       error=f"{type(e).__name__}: {e}",
+                       stranded=len(stranded))
 
     def _process(self, pending: List[tuple]) -> None:
         if not pending:
@@ -232,7 +351,9 @@ class PredictionServer(HTTPServerBase):
                  plugins: Optional[Sequence] = None,
                  engine=None, instance=None,
                  metrics: Optional[MetricsRegistry] = None):
-        super().__init__(host=config.ip, port=config.port, metrics=metrics)
+        super().__init__(host=config.ip, port=config.port, metrics=metrics,
+                         default_deadline_ms=config.default_deadline_ms,
+                         max_inflight=config.max_inflight)
         from predictionio_tpu.utils.security import KeyAuthentication
 
         self.config = config
@@ -245,7 +366,10 @@ class PredictionServer(HTTPServerBase):
         self._dep_lock = threading.Lock()
         self._batcher = (_MicroBatcher(config.batch_window_ms / 1000.0,
                                        config.batch_max,
-                                       obs=self._serve_obs)
+                                       obs=self._serve_obs,
+                                       queue_max=config.queue_max,
+                                       submit_timeout_s=(
+                                           config.submit_timeout_ms / 1000.0))
                         if config.batch_window_ms > 0 else None)
         # latency bookkeeping (CreateServer.scala:399-401,584-591);
         # updated from concurrent handler threads, hence the lock.
@@ -257,7 +381,8 @@ class PredictionServer(HTTPServerBase):
         # feedback loop: bounded queue + one worker instead of a thread
         # per request (send failures logged, not retried,
         # CreateServer.scala:557-566)
-        self._feedback_queue: "queue.Queue" = queue.Queue(maxsize=1024)
+        self._feedback_queue: "queue.Queue" = queue.Queue(
+            maxsize=config.feedback_queue_max)
         if config.feedback:
             threading.Thread(target=self._drain_feedback,
                              daemon=True).start()
@@ -277,15 +402,36 @@ class PredictionServer(HTTPServerBase):
         return inst
 
     def _load(self, instance=None) -> None:
-        engine = (self._engine_arg if self._engine_arg is not None
-                  else resolve_engine(self.config.engine_factory))
-        if instance is None:
-            instance = self._resolve_instance()
-        algos, models, serving = CoreWorkflow.prepare_deploy(
-            engine, instance, self.ctx)
+        """Build a full deployment, then swap atomically. Any failure
+        (resolve, storage read, model prepare) propagates BEFORE the
+        swap, so the previous deployment — if any — keeps serving
+        untouched (graceful-degradation contract of /reload)."""
+        try:
+            engine = (self._engine_arg if self._engine_arg is not None
+                      else resolve_engine(self.config.engine_factory))
+            if instance is None:
+                instance = self._resolve_instance()
+            algos, models, serving = CoreWorkflow.prepare_deploy(
+                engine, instance, self.ctx)
+        except Exception:
+            self._serve_obs.reloads.labels(outcome="failed").inc()
+            raise
         with self._dep_lock:
             self._dep = _Deployment(engine, instance, algos, models,
                                     serving, obs=self._serve_obs)
+        self._serve_obs.reloads.labels(outcome="ok").inc()
+
+    def readiness(self):
+        """/ready: a model must be loaded and no storage breaker OPEN."""
+        states = {}
+        try:
+            states = self.ctx.registry.breaker_states()
+        except Exception:
+            pass
+        open_breakers = [s for s, st in states.items() if st == "open"]
+        loaded = self._dep is not None
+        return (loaded and not open_breakers,
+                {"modelLoaded": loaded, "storageBreakers": states})
 
     @staticmethod
     def _probe_occupant(host: str, port: int):
@@ -335,7 +481,8 @@ class PredictionServer(HTTPServerBase):
             else:
                 query = query_json
         if self._batcher is not None:
-            prediction = self._batcher.submit(dep, query)
+            prediction = self._batcher.submit(dep, query,
+                                              deadline=current_deadline())
         else:
             prediction = dep.predict_batch([query])[0]
         # feedback loop + prId injection (CreateServer.scala:506-576)
@@ -365,9 +512,10 @@ class PredictionServer(HTTPServerBase):
                        pr_id: str) -> None:
         """Async POST of the predict event back to the event server via a
         bounded queue drained by one worker thread (no thread-per-request
-        spawn at serving throughput); send failures are logged, not
-        retried (CreateServer.scala:557-566), and enqueue overflow drops
-        the event with a log line rather than stalling the serve path."""
+        spawn at serving throughput); sends retry with jittered backoff
+        up to `feedback_retries` attempts and then DROP (counted in
+        pio_feedback_dropped_total), and enqueue overflow drops the
+        event with a log line rather than stalling the serve path."""
         data = {
             "event": "predict",
             "eventTime": format_time(utcnow()),
@@ -383,31 +531,42 @@ class PredictionServer(HTTPServerBase):
             self._feedback_queue.put_nowait(data)
         except queue.Full:
             self._serve_obs.feedback.labels(outcome="dropped").inc()
+            self._serve_obs.feedback_dropped.labels(
+                reason="queue_full").inc()
             self.obs_log.warning("feedback_dropped", reason="queue full")
 
-    def _drain_feedback(self) -> None:
+    def _send_feedback(self, data: Dict[str, Any]) -> None:
+        """One POST attempt; non-201 raises OSError so the retry policy
+        treats a refusing/erroring event server as transient."""
         import urllib.request
+        url = (f"http://{self.config.event_server_ip}:"
+               f"{self.config.event_server_port}/events.json"
+               f"?accessKey={self.config.access_key or ''}")
+        req = urllib.request.Request(
+            url, data=json.dumps(data).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            if resp.status != 201:
+                raise OSError(f"event server replied {resp.status}")
+
+    def _drain_feedback(self) -> None:
+        policy = RetryPolicy(
+            attempts=max(1, self.config.feedback_retries),
+            base_delay=0.1, max_delay=2.0, retryable=(OSError,))
         while True:
             data = self._feedback_queue.get()
-            url = (f"http://{self.config.event_server_ip}:"
-                   f"{self.config.event_server_port}/events.json"
-                   f"?accessKey={self.config.access_key or ''}")
-            req = urllib.request.Request(
-                url, data=json.dumps(data).encode(),
-                headers={"Content-Type": "application/json"}, method="POST")
             try:
-                with urllib.request.urlopen(req, timeout=5) as resp:
-                    if resp.status != 201:
-                        self._serve_obs.feedback.labels(
-                            outcome="failed").inc()
-                        self.obs_log.warning("feedback_failed",
-                                             status=resp.status)
-                    else:
-                        self._serve_obs.feedback.labels(
-                            outcome="sent").inc()
+                call_with_retry(self._send_feedback, data, policy=policy)
+                self._serve_obs.feedback.labels(outcome="sent").inc()
             except Exception as e:
+                # retries exhausted (or non-transient): drop, count, move
+                # on — feedback is best-effort and must never wedge the
+                # worker
                 self._serve_obs.feedback.labels(outcome="failed").inc()
-                self.obs_log.warning("feedback_failed", error=str(e))
+                self._serve_obs.feedback_dropped.labels(
+                    reason="send_failed").inc()
+                self.obs_log.warning("feedback_dropped",
+                                     reason="send failed", error=str(e))
 
     # -- routes ---------------------------------------------------------------
     def _routes(self) -> None:
@@ -444,9 +603,22 @@ class PredictionServer(HTTPServerBase):
             """Hot-swap to the latest COMPLETED instance
             (CreateServer.scala:316-342); key-authenticated like the
             reference's authenticate(withAccessKeyFromFile) guard
-            (CreateServer.scala:624-637)."""
+            (CreateServer.scala:624-637). A failed load ROLLS BACK: the
+            previous deployment keeps serving and the client gets a 500
+            naming the error (counted in pio_reload_total{outcome})."""
             self.auth.check(req)
-            self._load()
+            prev = self._dep
+            try:
+                self._load()
+            except Exception as e:
+                _log.error("reload_failed_rolled_back",
+                           error=f"{type(e).__name__}: {e}",
+                           serving_instance=(prev.instance.id
+                                             if prev else None))
+                raise HTTPError(
+                    500,
+                    f"Reload failed ({type(e).__name__}: {e}); previous "
+                    "deployment still serving")
             return Response.json({"message": "Reloaded"})
 
         @r.post("/stop")

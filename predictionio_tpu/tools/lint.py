@@ -17,6 +17,11 @@ Checks, per source file:
     ``print(`` or naked ``time.time()`` — telemetry goes through
     predictionio_tpu.obs (structured logs, histograms) so it is
     scrapable and request-correlated instead of lost on stdout
+  - resilient layers (serving/, data/) must not call ``.wait()`` with
+    no timeout (a crashed peer strands the waiter forever — pass a
+    bound, see predictionio_tpu.resilience.Deadline) nor ``time.sleep``
+    (hand-rolled retry pacing: use resilience.call_with_retry, which is
+    jittered, bounded, and deadline-aware)
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -41,6 +46,11 @@ _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
 # layers whose telemetry must flow through predictionio_tpu.obs
 _OBS_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
              "predictionio_tpu/core/")
+
+# layers where unbounded waits and ad-hoc sleep loops are forbidden —
+# everything on a request or storage path must finish or fail in
+# bounded time (predictionio_tpu.resilience supplies the bounded forms)
+_RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/")
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -167,6 +177,40 @@ def _check_instrumentation(tree: ast.AST, text: str,
                    "legitimate wall-clock use")
 
 
+def _check_bounded_waits(tree: ast.AST, text: str,
+                         rel: str) -> Iterator[str]:
+    """In serving/ and data/: forbid no-argument ``.wait()`` (an
+    Event/Condition wait with no timeout hangs forever when the peer
+    that would set it has died — satellite (a) of the resilience PR was
+    exactly this bug) and bare ``time.sleep(...)`` (hand-rolled retry
+    pacing; resilience.call_with_retry is the jittered, deadline-aware
+    form). ``# lint: ok`` on the line is the escape hatch for the few
+    legitimate uses (batch-window pacing, documented backstops)."""
+    if not rel.startswith(_RESILIENT_DIRS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr == "wait" and not node.args and not node.keywords:
+            yield (f"{rel}:{node.lineno}: unbounded .wait() — a dead "
+                   "setter strands this thread forever; pass a timeout "
+                   "(deadline.remaining() or a documented backstop), "
+                   "or mark '# lint: ok'")
+        elif fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            yield (f"{rel}:{node.lineno}: bare time.sleep() in a "
+                   "resilient layer; use resilience.call_with_retry "
+                   "for retry pacing, or mark '# lint: ok' for "
+                   "legitimate fixed waits")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -184,6 +228,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_excepts(tree, rel))
     out.extend(_check_lines(text, rel))
     out.extend(_check_instrumentation(tree, text, rel))
+    out.extend(_check_bounded_waits(tree, text, rel))
     return out
 
 

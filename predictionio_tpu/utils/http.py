@@ -18,6 +18,14 @@ request_id), a route/method/status counter and a per-route latency
 histogram; every server serves its registry on `GET /metrics` in
 Prometheus text format. Unhandled handler errors are logged structured
 with the request id instead of a bare traceback print.
+
+Resilience middleware (predictionio_tpu.resilience): `X-PIO-Deadline-Ms`
+(or the server's `default_deadline_ms`) becomes a propagated Deadline —
+expiry anywhere under the handler maps to 504; an open storage circuit
+breaker maps to 503 + Retry-After; admission past `max_inflight` sheds
+with 429 + Retry-After. Every server also answers `GET /health`
+(liveness: the process responds) and `GET /ready` (readiness: the
+subclass `readiness()` hook — model loaded, breakers closed).
 """
 
 from __future__ import annotations
@@ -29,11 +37,15 @@ import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, List, Mapping, Optional, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
 from predictionio_tpu.obs import (
     MetricsRegistry, get_logger, get_registry, new_request_id,
+)
+from predictionio_tpu.resilience import (
+    DEADLINE_HEADER, Deadline, DeadlineExceeded, CircuitOpenError,
+    InflightLimiter, OverloadedError, deadline_from_header, deadline_scope,
 )
 
 _log = get_logger("http")
@@ -50,6 +62,7 @@ class Request:
     client: str = ""
     request_id: str = ""       # assigned by the middleware, never empty there
     route: str = ""            # matched route pattern (metrics label)
+    deadline: Optional[Deadline] = None   # from X-PIO-Deadline-Ms / default
 
     def json(self) -> Any:
         if not self.body:
@@ -58,6 +71,19 @@ class Request:
             return json.loads(self.body.decode("utf-8"))
         except json.JSONDecodeError as e:
             raise ValueError(f"Invalid JSON: {e}") from e
+
+    def header(self, name: str, default: Optional[str] = None
+               ) -> Optional[str]:
+        """Case-insensitive header lookup (clients and proxies disagree
+        on canonical casing; RFC 7230 says names are case-insensitive)."""
+        v = self.headers.get(name)
+        if v is not None:
+            return v
+        lname = name.lower()
+        for k, val in self.headers.items():
+            if k.lower() == lname:
+                return val
+        return default
 
     def query_get(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.query.get(name, default)
@@ -89,10 +115,12 @@ Handler = Callable[[Request], Response]
 class HTTPError(Exception):
     """Raise from a handler to produce a JSON error response."""
 
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Mapping[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers: Dict[str, str] = dict(headers or {})
 
 
 def _compile(pattern: str) -> re.Pattern:
@@ -146,7 +174,20 @@ class Router:
                     try:
                         return fn(req)
                     except HTTPError as e:
-                        return Response.json({"message": e.message}, e.status)
+                        return Response.json({"message": e.message}, e.status,
+                                             **e.headers)
+                    except DeadlineExceeded as e:
+                        return Response.json({"message": str(e)}, 504)
+                    except CircuitOpenError as e:
+                        return Response.json(
+                            {"message": str(e)}, 503,
+                            **{"Retry-After": str(max(1, round(
+                                e.retry_after)))})
+                    except OverloadedError as e:
+                        return Response.json(
+                            {"message": e.message}, e.status,
+                            **{"Retry-After": str(max(1, round(
+                                e.retry_after)))})
                     except ValueError as e:
                         return Response.json({"message": str(e)}, 400)
                     except Exception as e:
@@ -170,7 +211,9 @@ class HTTPServerBase:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  ssl_context: Optional[ssl_module.SSLContext] = None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 default_deadline_ms: int = 0,
+                 max_inflight: int = 0):
         self.host = host
         self.port = port
         self.router = Router()
@@ -188,16 +231,69 @@ class HTTPServerBase:
         self._req_hist = self.metrics.histogram(
             "pio_http_request_duration_seconds",
             "HTTP request wall time by matched route", labels=("route",))
+        # resilience: per-request deadline default + HTTP-plane admission
+        self.default_deadline_ms = default_deadline_ms
+        self._limiter = InflightLimiter(
+            max_inflight, surface=type(self).__name__)
+        self._shed_counter = self.metrics.counter(
+            "pio_shed_total", "Requests shed by surface at admission",
+            labels=("surface",))
+        self._deadline_counter = self.metrics.counter(
+            "pio_deadline_expired_total",
+            "Requests that exhausted their deadline", labels=("route",))
         self.router.get("/metrics")(self._metrics_endpoint)
+        self.router.get("/health")(self._health_endpoint)
+        self.router.get("/ready")(self._ready_endpoint)
 
     def _metrics_endpoint(self, req: Request) -> Response:
         return Response.text(
             self.metrics.render(),
             content_type="text/plain; version=0.0.4; charset=utf-8")
 
+    # -- health/readiness ---------------------------------------------------
+    def readiness(self) -> Tuple[bool, Dict[str, Any]]:
+        """Subclass hook: (ready?, detail). Default: serving = ready."""
+        return True, {}
+
+    def _health_endpoint(self, req: Request) -> Response:
+        """Liveness: the process accepts connections and can respond."""
+        return Response.json({"status": "ok"})
+
+    def _ready_endpoint(self, req: Request) -> Response:
+        """Readiness: fit to take traffic (model loaded, breakers
+        closed); 503 tells the load balancer to route elsewhere."""
+        ok, detail = self.readiness()
+        body = {"ready": ok}
+        body.update(detail)
+        return Response.json(body, 200 if ok else 503)
+
+    def _handle(self, req: Request) -> Response:
+        """Resilience middleware around dispatch: deadline extraction +
+        propagation (contextvar, for storage/batcher calls below the
+        handler) and in-flight admission control."""
+        try:
+            req.deadline = deadline_from_header(
+                req.header(DEADLINE_HEADER), self.default_deadline_ms)
+        except ValueError as e:
+            return Response.json({"message": str(e)}, 400)
+        if req.deadline is not None and req.deadline.expired:
+            return Response.json(
+                {"message": "deadline expired before processing"}, 504)
+        try:
+            with self._limiter:
+                with deadline_scope(req.deadline):
+                    return self.router.dispatch(req)
+        except OverloadedError as e:
+            self._shed_counter.labels(surface=self._limiter.surface).inc()
+            return Response.json(
+                {"message": e.message}, e.status,
+                **{"Retry-After": str(max(1, round(e.retry_after)))})
+
     def _observe_request(self, req: Request, resp: Response,
                          duration: float) -> None:
         route = req.route or "(unmatched)"
+        if resp.status == 504:
+            self._deadline_counter.labels(route=route).inc()
         self._req_counter.labels(
             route=route, method=req.method, status=str(resp.status)).inc()
         self._req_hist.labels(route=route).observe(duration)
@@ -218,18 +314,33 @@ class HTTPServerBase:
                 parsed = urlparse(self.path)
                 raw_q = parse_qs(parsed.query, keep_blank_values=True)
                 query = {k: v[0] for k, v in raw_q.items()}
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 rid = self.headers.get("X-Request-ID") or new_request_id()
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length < 0:
+                        raise ValueError("negative Content-Length")
+                except ValueError:
+                    # malformed framing: answer 400 instead of resetting
+                    # the connection with no response at all; the body
+                    # was never read, so the connection must close
+                    self.close_connection = True
+                    self._reply(Response.json(
+                        {"message": "Invalid Content-Length header"},
+                        400), rid)
+                    return
+                body = self.rfile.read(length) if length else b""
                 req = Request(
                     method=self.command, path=parsed.path, query=query,
                     headers={k: v for k, v in self.headers.items()},
                     body=body, client=self.client_address[0],
                     request_id=rid)
                 started = time.perf_counter()
-                resp = router.dispatch(req)
+                resp = server_ref._handle(req)
                 server_ref._observe_request(
                     req, resp, time.perf_counter() - started)
+                self._reply(resp, rid)
+
+            def _reply(self, resp: Response, rid: str) -> None:
                 payload = resp.body
                 if isinstance(payload, bytes):
                     data = payload

@@ -79,3 +79,43 @@ def test_instrumentation_gate_line_escape(tmp_path):
         "    return time.time() - mtime < ttl  # lint: ok\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_bounded_wait_gate_catches_unbounded_wait_and_sleep(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "hangs.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import time\n"
+        "def f(done):\n"
+        "    done.wait()\n"
+        "    time.sleep(1)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "unbounded .wait()" in kinds
+    assert "bare time.sleep()" in kinds
+
+
+def test_bounded_wait_gate_allows_timeouts_and_escapes(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "data" / "waits.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import time\n"
+        "def f(done):\n"
+        "    done.wait(5.0)\n"
+        "    time.sleep(0.01)  # lint: ok\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_bounded_wait_gate_scoped_to_resilient_layers(tmp_path):
+    # core/ and cli/ are not request/storage paths
+    ok = tmp_path / "predictionio_tpu" / "core" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def f(done):\n"
+        "    done.wait()\n"
+    )
+    assert not lint.run(tmp_path)

@@ -1,0 +1,570 @@
+"""Resilience layer: unit behavior + chaos scenarios over live HTTP.
+
+The chaos half drives the fault-injection harness
+(predictionio_tpu.resilience.faults) against running servers: storage
+flakes that retry must absorb, breaker trips that must fast-fail and
+recover, bursts that must shed instead of hang, deadlines that must
+produce a 504 on time, reloads that must roll back. Every scenario is
+tuned to finish in well under a second so the suite rides inside tier-1
+(the `chaos` marker exists for selection, not exclusion).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.core import (
+    CoreWorkflow, Engine, EngineParams, RuntimeContext,
+)
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.eventserver import EventServer, EventServerConfig
+from predictionio_tpu.data.storage import AccessKey, App, StorageRegistry
+from predictionio_tpu.obs import MetricsRegistry
+from predictionio_tpu.resilience import (
+    CircuitBreaker, CircuitOpenError, Deadline, DeadlineExceeded, FaultError,
+    InflightLimiter, OverloadedError, RetryPolicy, call_with_retry,
+    deadline_from_header, deadline_scope, faults,
+)
+from predictionio_tpu.serving import PredictionServer, ServerConfig
+
+import sample_engine as se
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with the chaos harness disarmed."""
+    faults().clear()
+    yield
+    faults().clear()
+
+
+def call(port, method, path, body=None, headers=None):
+    """Like test_serving.call but also returns the response headers."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            parsed = json.loads(raw) if "json" in ct else raw
+            return resp.status, parsed, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+# -- unit: deadlines ---------------------------------------------------------
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        d = Deadline.after_ms(10000)
+        assert 9.0 < d.remaining() <= 10.0 and not d.expired
+        d2 = Deadline.after_ms(-1)
+        assert d2.expired and d2.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d2.check("unit")
+
+    def test_header_parsing(self):
+        assert deadline_from_header(None) is None
+        assert deadline_from_header("") is None
+        d = deadline_from_header(None, default_ms=500)
+        assert d is not None and d.remaining() <= 0.5
+        assert deadline_from_header("250").remaining() <= 0.25
+        for bad in ("abc", "0", "-5"):
+            with pytest.raises(ValueError):
+                deadline_from_header(bad)
+
+    def test_scope_contextvar(self):
+        from predictionio_tpu.resilience import current_deadline
+        assert current_deadline() is None
+        d = Deadline.after_s(1)
+        with deadline_scope(d):
+            assert current_deadline() is d
+        assert current_deadline() is None
+
+
+# -- unit: retry -------------------------------------------------------------
+
+class TestRetry:
+    def test_flake_then_success(self):
+        calls = {"n": 0}
+        slept = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError("flake")
+            return "ok"
+
+        out = call_with_retry(flaky, policy=RetryPolicy(attempts=3),
+                              sleep=slept.append)
+        assert out == "ok" and calls["n"] == 3 and len(slept) == 2
+        assert slept[1] > 0  # backoff delays are real, jittered floats
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise ValueError("client error")
+
+        with pytest.raises(ValueError):
+            call_with_retry(boom, policy=RetryPolicy(attempts=5),
+                            sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_attempts_exhausted(self):
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            call_with_retry(always, policy=RetryPolicy(attempts=3),
+                            sleep=lambda s: None)
+
+    def test_deadline_aborts_backoff(self):
+        """With less budget than the next backoff, retry gives up rather
+        than sleeping through the caller's 504."""
+        calls = {"n": 0}
+        slept = []
+
+        def flaky():
+            calls["n"] += 1
+            raise OSError("flake")
+
+        with deadline_scope(Deadline.after_ms(1)):
+            with pytest.raises(OSError):
+                call_with_retry(
+                    flaky, sleep=slept.append,
+                    policy=RetryPolicy(attempts=5, base_delay=10.0,
+                                       jitter=0.0))
+        assert calls["n"] == 1 and slept == []
+
+
+# -- unit: circuit breaker ---------------------------------------------------
+
+class TestBreaker:
+    def make(self, **kw):
+        clock = {"t": 0.0}
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("recovery_time", 10.0)
+        b = CircuitBreaker("unit", clock=lambda: clock["t"],
+                           metrics=MetricsRegistry(), **kw)
+        return b, clock
+
+    def test_trip_fastfail_halfopen_recover(self):
+        b, clock = self.make()
+        for _ in range(2):
+            with pytest.raises(OSError):
+                b.call(self._raise_oserror)
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError) as ei:
+            b.call(lambda: "never runs")
+        assert ei.value.retry_after <= 10.0
+        clock["t"] = 11.0           # recovery window passed -> half-open
+        assert b.call(lambda: "probe") == "probe"
+        assert b.state == "closed"
+
+    def test_halfopen_probe_failure_reopens(self):
+        b, clock = self.make()
+        for _ in range(2):
+            with pytest.raises(OSError):
+                b.call(self._raise_oserror)
+        clock["t"] = 11.0
+        with pytest.raises(OSError):
+            b.call(self._raise_oserror)   # the probe fails
+        assert b.state == "open"          # straight back, fresh timer
+        with pytest.raises(CircuitOpenError):
+            b.call(lambda: 1)
+
+    def test_client_errors_do_not_trip(self):
+        b, _ = self.make()
+
+        def client_error():
+            raise KeyError("not a backend failure")
+
+        for _ in range(5):
+            with pytest.raises(KeyError):
+                b.call(client_error, failure_types=(OSError,))
+        assert b.state == "closed"
+
+    @staticmethod
+    def _raise_oserror():
+        raise OSError("backend down")
+
+
+# -- unit: faults + shedding -------------------------------------------------
+
+class TestFaultInjector:
+    def test_n_then_succeed_and_prefix_match(self):
+        rule = faults().arm("storage.X", error=OSError, times=2)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                faults().check("storage.X.Events.insert")
+        faults().check("storage.X.Events.insert")   # exhausted: passes
+        assert rule.hits == 2
+        faults().check("storage.Y.Events.insert")   # different prefix
+        assert rule.hits == 2
+
+    def test_latency_injection(self):
+        faults().arm("slow.seam", latency=0.05)
+        t0 = time.perf_counter()
+        faults().check("slow.seam")
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_clear_disarms(self):
+        faults().arm("x", error=FaultError)
+        faults().clear()
+        faults().check("x")   # no raise
+
+
+class TestInflightLimiter:
+    def test_sheds_past_cap_with_429(self):
+        lim = InflightLimiter(1, surface="unit")
+        with lim:
+            with pytest.raises(OverloadedError) as ei:
+                with lim:
+                    pass
+        assert ei.value.status == 429
+        with lim:   # slot released
+            pass
+
+
+# -- chaos: storage ----------------------------------------------------------
+
+class TestStorageChaos:
+    def test_flake_absorbed_by_retry(self, mem_registry):
+        events = mem_registry.get_events()
+        events.init(1)
+        rule = faults().arm("storage.MEM.Events.insert",
+                            error=OSError, times=2)
+        eid = events.insert(Event(event="buy", entity_type="user",
+                                  entity_id="u1"), 1)
+        assert eid and rule.hits == 2   # two flakes eaten, then success
+        assert list(events.find(1))
+
+    def _flaky_registry(self):
+        return StorageRegistry({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_RETRY_ATTEMPTS": "1",
+            "PIO_STORAGE_SOURCES_MEM_BREAKER_THRESHOLD": "2",
+            "PIO_STORAGE_SOURCES_MEM_BREAKER_RECOVERY_S": "0.05",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+
+    def test_breaker_trips_fastfails_and_recovers(self):
+        reg = self._flaky_registry()
+        events = reg.get_events()
+        events.init(1)
+        ev = Event(event="buy", entity_type="user", entity_id="u1")
+        faults().arm("storage.MEM.Events", error=OSError)
+        for _ in range(2):          # threshold=2, attempts=1: two trips
+            with pytest.raises(OSError):
+                events.insert(ev, 1)
+        assert reg.breaker_states() == {"MEM": "open"}
+        t0 = time.perf_counter()
+        with pytest.raises(CircuitOpenError):
+            events.insert(ev, 1)    # fast-fail: no backend round-trip
+        assert time.perf_counter() - t0 < 0.05
+        faults().clear()            # backend "recovers"
+        time.sleep(0.06)            # > BREAKER_RECOVERY_S
+        assert events.insert(ev, 1)     # half-open probe succeeds
+        assert reg.breaker_states() == {"MEM": "closed"}
+
+    def test_eventserver_503_when_breaker_open(self):
+        reg = self._flaky_registry()
+        apps = reg.get_meta_data_apps()
+        app_id = apps.insert(App(0, "chaosapp"))
+        reg.get_meta_data_access_keys().insert(AccessKey("CK", app_id, ()))
+        es = EventServer(EventServerConfig(ip="127.0.0.1", port=0), reg)
+        es.start()
+        try:
+            body = {"event": "buy", "entityType": "user", "entityId": "u1"}
+            path = "/events.json?accessKey=CK"
+            code, _, _ = call(es.port, "POST", path, body)
+            assert code == 201
+            # the whole MEM source goes down (every DAO: the per-source
+            # breaker counts consecutive post-retry failures, and any
+            # succeeding call on the source resets the streak)
+            faults().arm("storage.MEM", error=OSError)
+            for _ in range(2):
+                code, _, _ = call(es.port, "POST", path, body)
+                assert code == 500      # retries exhausted, breaker counts
+            code, resp, hdrs = call(es.port, "POST", path, body)
+            assert code == 503          # breaker open: fast 503
+            assert "Retry-After" in hdrs
+            code, resp, _ = call(es.port, "GET", "/ready")
+            assert code == 503 and resp["ready"] is False
+            assert resp["storageBreakers"]["MEM"] == "open"
+            faults().clear()
+            time.sleep(0.06)
+            code, _, _ = call(es.port, "POST", path, body)
+            assert code == 201          # recovered through half-open
+            code, resp, _ = call(es.port, "GET", "/ready")
+            assert code == 200 and resp["ready"] is True
+        finally:
+            es.shutdown()
+
+
+# -- chaos: serving ----------------------------------------------------------
+
+def sample_serving_engine():
+    return Engine(
+        data_source={"": se.SDataSource},
+        preparator=se.SPreparator,
+        algorithms={"algo": se.SAlgo},
+        serving={"": se.SServing, "sum": se.SServingSum},
+    )
+
+
+def train_sample(registry, two_algos=False):
+    engine = sample_serving_engine()
+    algos = (("algo", se.SAlgoParams(id=9)),)
+    serving = ("", se.SServingParams())
+    if two_algos:
+        algos = (("algo", se.SAlgoParams(id=9)), ("algo", se.SAlgoParams(id=5)))
+        serving = ("sum", se.SServingParams())
+    params = EngineParams(
+        data_source_params=("", se.SDataSourceParams(id=7)),
+        preparator_params=("", se.SPreparatorParams(id=8)),
+        algorithm_params_list=algos,
+        serving_params=serving,
+    )
+    CoreWorkflow.run_train(engine, params, RuntimeContext(registry=registry))
+    return engine
+
+
+def start_server(registry, engine, **cfg):
+    config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+    srv = PredictionServer(config, registry=registry, engine=engine,
+                           metrics=MetricsRegistry())
+    srv.start()
+    return srv
+
+
+class TestServingChaos:
+    def test_health_and_ready(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine)
+        try:
+            code, body, _ = call(srv.port, "GET", "/health")
+            assert code == 200 and body["status"] == "ok"
+            code, body, _ = call(srv.port, "GET", "/ready")
+            assert code == 200 and body["ready"] is True
+            assert body["modelLoaded"] is True
+        finally:
+            srv.shutdown()
+
+    def test_queue_full_sheds_under_burst(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine, batch_window_ms=40,
+                           queue_max=2)
+        try:
+            faults().arm("serve.predict", latency=0.3)
+            results = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(10)
+
+            def one(i):
+                barrier.wait()
+                out = call(srv.port, "POST", "/queries.json", {"q": i})
+                with lock:
+                    results.append(out)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            statuses = [r[0] for r in results]
+            assert len(statuses) == 10          # nobody hangs
+            assert statuses.count(200) >= 1     # admitted work finishes
+            sheds = [r for r in results if r[0] == 503]
+            assert sheds                        # excess is rejected...
+            assert all("Retry-After" in r[2] for r in sheds)
+            assert srv.metrics.value(
+                "pio_shed_total", surface="queries") >= len(sheds)
+        finally:
+            srv.shutdown()
+
+    def test_deadline_expiry_504_on_time(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine, batch_window_ms=20)
+        try:
+            faults().arm("serve.predict", latency=0.5)
+            t0 = time.perf_counter()
+            code, body, _ = call(srv.port, "POST", "/queries.json",
+                                 {"q": 1}, headers={"X-PIO-Deadline-Ms": "100"})
+            elapsed = time.perf_counter() - t0
+            assert code == 504
+            assert elapsed < 0.45   # inside deadline + margin, NOT the 0.5s
+            assert srv.metrics.value("pio_deadline_expired_total",
+                                     route="/queries.json") >= 1
+        finally:
+            srv.shutdown()
+
+    def test_expired_deadline_rejected_upfront(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine)
+        try:
+            code, _, _ = call(srv.port, "POST", "/queries.json", {"q": 1},
+                              headers={"X-PIO-Deadline-Ms": "nope"})
+            assert code == 400
+        finally:
+            srv.shutdown()
+
+    def test_crashed_drainer_fails_fast_then_recovers(self, mem_registry,
+                                                      monkeypatch):
+        """Satellite (a): a dead drainer must never strand a request.
+        The crash fails the in-flight waiter immediately (5xx, not a
+        hang) and the NEXT request gets a fresh, healthy drainer."""
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine, batch_window_ms=20)
+        try:
+            batcher = srv._batcher
+
+            def boom(pending):
+                raise RuntimeError("drainer crashed")
+
+            monkeypatch.setattr(batcher, "_process", boom)
+            t0 = time.perf_counter()
+            code, body, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 500 and time.perf_counter() - t0 < 5.0
+            assert not batcher._draining    # flag cleared for the next one
+            monkeypatch.undo()
+            code, _, _ = call(srv.port, "POST", "/queries.json", {"q": 2})
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+    def test_algo_isolation_degrades_not_fails(self, mem_registry):
+        """Two algorithms; one injected failure must degrade the answer
+        (sum of the survivors), not 500 the query — unless BOTH fail."""
+        engine = sample_serving_engine()
+        train_sample(mem_registry, two_algos=True)
+        srv = start_server(mem_registry, engine)
+        try:
+            code, body, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 200 and body == 14   # 9 + 5, both alive
+            faults().arm("serve.predict.0:SAlgo", error=FaultError)
+            code, body, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 200 and body == 5    # degraded to the survivor
+            assert srv.metrics.value("pio_algo_errors_total",
+                                     algo="0:SAlgo") >= 1
+            faults().arm("serve.predict.1:SAlgo", error=FaultError)
+            code, _, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 500                  # all algos dead: surface it
+        finally:
+            srv.shutdown()
+
+    def test_failed_reload_rolls_back(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine)
+        try:
+            serving_instance = srv._dep.instance.id
+            faults().arm("deploy.prepare", error=FaultError)
+            code, body, _ = call(srv.port, "POST", "/reload")
+            assert code == 500
+            assert "previous deployment still serving" in body["message"]
+            assert srv._dep.instance.id == serving_instance
+            code, _, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 200                  # last-good keeps serving
+            assert srv.metrics.value("pio_reload_total",
+                                     outcome="failed") >= 1
+            faults().clear()
+            code, _, _ = call(srv.port, "POST", "/reload")
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+    def test_feedback_retries_then_drops_counted(self, mem_registry):
+        """Satellite (c): with the event server gone, feedback posts are
+        retried and then DROPPED (counted), never wedging the worker."""
+        engine = train_sample(mem_registry)
+        with socket.socket() as s:              # a port with no listener
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        srv = start_server(mem_registry, engine, feedback=True,
+                           event_server_ip="127.0.0.1",
+                           event_server_port=dead_port,
+                           feedback_retries=2)
+        try:
+            code, _, _ = call(srv.port, "POST", "/queries.json", {"q": 1})
+            assert code == 200                  # serve path unaffected
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if srv.metrics.value("pio_feedback_dropped_total",
+                                     reason="send_failed") >= 1:
+                    break
+                time.sleep(0.02)
+            assert srv.metrics.value("pio_feedback_dropped_total",
+                                     reason="send_failed") >= 1
+        finally:
+            srv.shutdown()
+
+    def test_max_inflight_sheds_429(self, mem_registry):
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine, max_inflight=1)
+        try:
+            faults().arm("serve.predict", latency=0.3)
+            results = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(4)
+
+            def one(i):
+                barrier.wait()
+                out = call(srv.port, "POST", "/queries.json", {"q": i})
+                with lock:
+                    results.append(out)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            statuses = sorted(r[0] for r in results)
+            assert statuses.count(429) >= 1 and statuses.count(200) >= 1
+        finally:
+            srv.shutdown()
+
+
+# -- chaos: HTTP plane hardening ---------------------------------------------
+
+class TestMalformedContentLength:
+    def test_raw_socket_garbage_content_length_gets_400(self, mem_registry):
+        """Satellite (b): a malformed Content-Length must produce a 400
+        JSON response, not an unhandled ValueError in the handler."""
+        engine = train_sample(mem_registry)
+        srv = start_server(mem_registry, engine)
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=5) as sock:
+                sock.sendall(b"POST /queries.json HTTP/1.1\r\n"
+                             b"Host: x\r\n"
+                             b"Content-Length: banana\r\n"
+                             b"\r\n")
+                chunks = []
+                while True:     # server closes after the 400
+                    part = sock.recv(4096)
+                    if not part:
+                        break
+                    chunks.append(part)
+                raw = b"".join(chunks).decode(errors="replace")
+            assert raw.startswith("HTTP/1.1 400")
+            assert "Invalid Content-Length" in raw
+        finally:
+            srv.shutdown()
