@@ -163,6 +163,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="subcommand to run, e.g. -- eventserver --port 7070")
 
     # misc -----------------------------------------------------------------
+    x = sub.add_parser(
+        "doctor",
+        help="durability check: fsck every bound store (corrupt model "
+             "blobs, torn journal tails, stale indexes) + the stale-"
+             "instance janitor; --repair to act")
+    x.add_argument("--repair", action="store_true",
+                   help="quarantine/truncate/rebuild/fail instead of "
+                        "just reporting")
+    x.add_argument("--stale-after", type=float, default=None,
+                   help="seconds before an INIT/TRAINING instance with "
+                        "no heartbeat counts as dead (default 900)")
     sub.add_parser("status")
     sub.add_parser("version")
     x = sub.add_parser("import")
@@ -320,6 +331,13 @@ def main(argv: Optional[list] = None) -> int:
         if cmd == "status":
             _emit(ops.status(_registry()))
             return 0
+        if cmd == "doctor":
+            report = ops.doctor(_registry(), repair=args.repair,
+                                stale_after_s=args.stale_after)
+            _emit(report)
+            # rc 1 = damage found and not repaired (report-only mode or
+            # a repair that could not act); clean or fully repaired = 0
+            return 1 if report["unrepaired"] else 0
         if cmd == "start-all":
             from predictionio_tpu.cli import service
             _emit(service.start_all(

@@ -473,6 +473,16 @@ def status(registry) -> Dict[str, Any]:
     return info
 
 
+def doctor(registry, *, repair: bool = False,
+           stale_after_s: Optional[float] = None) -> Dict[str, Any]:
+    """`pio doctor`: store-wide fsck + stale-instance janitor report."""
+    from predictionio_tpu.data import fsck
+    return fsck.doctor(
+        registry, repair=repair,
+        stale_after_s=(stale_after_s if stale_after_s is not None
+                       else fsck.DEFAULT_STALE_S))
+
+
 # ---------------------------------------------------------------------------
 # import / export (tools/.../{imprt,export})
 # ---------------------------------------------------------------------------

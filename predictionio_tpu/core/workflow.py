@@ -12,6 +12,7 @@ Parity targets:
 from __future__ import annotations
 
 import importlib
+import threading
 from typing import Any, List, Optional, Tuple
 
 from predictionio_tpu.core.engine import Engine, EngineFactory
@@ -72,6 +73,43 @@ def resolve_engine(factory_name: str) -> Engine:
     raise TypeError(f"{factory_name!r} did not produce an Engine")
 
 
+def _heartbeat_interval(registry) -> float:
+    """`PIO_TRAIN_HEARTBEAT_S` (default 5s); <= 0 disables the beat."""
+    cfg = getattr(registry, "config", {}) or {}
+    try:
+        return float(cfg.get("PIO_TRAIN_HEARTBEAT_S", 5.0))
+    except (TypeError, ValueError):
+        return 5.0
+
+
+def _start_heartbeat(instances, instance_id: str, stop: threading.Event,
+                     interval_s: float) -> Optional[threading.Thread]:
+    if interval_s <= 0:
+        return None
+
+    def beat():
+        while not stop.wait(interval_s):
+            try:
+                instances.record_heartbeat(instance_id)
+            except Exception as e:
+                # a failed beat must never kill the train; the janitor
+                # threshold absorbs gaps far longer than one interval
+                _log.warning("heartbeat_failed", instance_id=instance_id,
+                             error=f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=beat, name=f"pio-heartbeat-{instance_id}",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def _stop_heartbeat(stop: threading.Event,
+                    thread: Optional[threading.Thread]) -> None:
+    stop.set()
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=10.0)
+
+
 class CoreWorkflow:
     """Training orchestration with engine-instance lifecycle."""
 
@@ -124,14 +162,26 @@ class CoreWorkflow:
             serving_params=_named_params_json(engine_params.serving_params),
         )
         instance_id = instances.insert(row)
-        row = row.with_(id=instance_id, status=EngineInstanceStatus.TRAINING)
+        row = row.with_(id=instance_id,
+                        status=EngineInstanceStatus.TRAINING,
+                        heartbeat=utcnow())
         instances.update(row)
+        # liveness beats let the stale-instance janitor distinguish a
+        # long-running train from one whose process died mid-run
+        stop_beat = threading.Event()
+        beat_thread = _start_heartbeat(
+            instances, instance_id, stop_beat,
+            interval_s=_heartbeat_interval(registry))
         try:
             models = engine.train(ctx, engine_params)
             record_train_phases(ctx.phase_timings)
             _, _, algos, _ = engine.make_components(engine_params)
             blob = serialize_models(instance_id, algos, models, ctx)
             registry.get_model_data_models().insert(Model(instance_id, blob))
+            # the beat thread must be down BEFORE the terminal status
+            # write: a concurrent get+update beat could resurrect the
+            # TRAINING row after COMPLETED landed
+            _stop_heartbeat(stop_beat, beat_thread)
             row = row.with_(
                 status=EngineInstanceStatus.COMPLETED, end_time=utcnow(),
                 # per-phase timings travel with the instance: `pio
@@ -142,12 +192,15 @@ class CoreWorkflow:
             instances.update(row)
             return row
         except Exception as e:
+            _stop_heartbeat(stop_beat, beat_thread)
             _log.exception("train_failed", instance_id=instance_id,
                            error=f"{type(e).__name__}: {e}")
             row = row.with_(status=EngineInstanceStatus.FAILED,
                             end_time=utcnow())
             instances.update(row)
             raise
+        finally:
+            _stop_heartbeat(stop_beat, beat_thread)
 
     @staticmethod
     def prepare_deploy(engine: Engine, instance: EngineInstance,

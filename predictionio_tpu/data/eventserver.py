@@ -91,6 +91,11 @@ class EventServer(HTTPServerBase):
             "pio_ingest_payload_bytes",
             "Ingest request payload size in bytes",
             buckets=PAYLOAD_BUCKETS)
+        # restart-recovery sweep (torn journal tails are an event-store
+        # concern; report-only unless `pio doctor --repair`)
+        from predictionio_tpu.data.fsck import startup_check
+        from predictionio_tpu.obs import get_logger
+        startup_check(self.registry, log=get_logger("eventserver").warning)
         self._install_routes()
 
     # -- readiness ----------------------------------------------------------

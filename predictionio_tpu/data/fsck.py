@@ -1,0 +1,183 @@
+"""Store-wide recovery pass (fsck) + stale-instance janitor + doctor.
+
+The restart-recovery layer: after any crash the server must come back
+clean, so startup (and `pio doctor` on demand) sweeps every bound
+storage repository for damage a crash can leave behind:
+
+  - corrupt model blobs (torn writes, bit rot) -> quarantined with a
+    reason, so deploy falls back to the latest intact COMPLETED
+    instance instead of dying on an unpickling traceback
+  - torn event-journal tails -> truncated to the last valid frame (a
+    torn tail silently hides every FUTURE append from scans)
+  - stale segment sidecar indexes -> rebuilt from the journal
+  - INIT/TRAINING engine-instance rows whose heartbeat went stale (a
+    `pio train` killed mid-run) -> transitioned to FAILED so
+    `get_latest_completed` resolution is deterministic again
+
+Drivers opt in by exposing `fsck(repair: bool) -> List[dict]` (the
+verify()/repair() DAO contract); each finding dict carries at least
+`kind`, `reason`, and `action`. Everything is reported through
+`pio_fsck_*` / `pio_janitor_*` metrics.
+
+Knobs: `PIO_FSCK_ON_STARTUP` (default on; report-only),
+`PIO_JANITOR` (default on at startup), `PIO_JANITOR_STALE_S`
+(default 900s).
+"""
+
+from __future__ import annotations
+
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.data.storage.base import (
+    EngineInstanceStatus, StorageError, _aware,
+)
+from predictionio_tpu.obs import get_registry
+
+DEFAULT_STALE_S = 900.0
+
+
+def _metrics():
+    reg = get_registry()
+    return {
+        "runs": reg.counter(
+            "pio_fsck_runs_total", "fsck passes executed",
+            labels=("mode",)),
+        "findings": reg.counter(
+            "pio_fsck_findings_total", "fsck findings by kind",
+            labels=("kind",)),
+        "quarantined": reg.counter(
+            "pio_fsck_quarantined_total",
+            "Corrupt model blobs moved to quarantine"),
+        "repaired": reg.counter(
+            "pio_fsck_repaired_total", "fsck findings repaired"),
+        "janitor": reg.counter(
+            "pio_janitor_failed_total",
+            "Stale INIT/TRAINING instances transitioned to FAILED"),
+    }
+
+
+def fsck_registry(registry, repair: bool = False) -> List[dict]:
+    """Run every bound repository DAO's fsck; returns all findings.
+
+    Scans the MODELDATA Models DAO and the EVENTDATA Events DAO (the
+    two stores a crash can tear); DAOs without an fsck method (e.g.
+    MEM) contribute nothing. Never raises on a per-DAO failure — a
+    broken store must not prevent the rest from being checked.
+    """
+    m = _metrics()
+    m["runs"].labels(mode="repair" if repair else "report").inc()
+    findings: List[dict] = []
+    daos = []
+    try:
+        daos.append(("models", registry.get_model_data_models()))
+    except StorageError:
+        pass
+    try:
+        daos.append(("events", registry.get_events()))
+    except StorageError:
+        pass
+    for repo, dao in daos:
+        run = getattr(dao, "fsck", None)
+        if run is None:
+            continue
+        try:
+            found = run(repair=repair)
+        except (StorageError, OSError) as exc:
+            found = [{"kind": "fsck_error", "repo": repo,
+                      "reason": str(exc), "action": "none"}]
+        for f in found:
+            f.setdefault("repo", repo)
+            m["findings"].labels(kind=f.get("kind", "unknown")).inc()
+            acted = f.get("action", "none") != "none"
+            if acted:
+                m["repaired"].inc()
+            if f.get("kind") == "corrupt_blob" and acted:
+                m["quarantined"].inc()
+        findings.extend(found)
+    return findings
+
+
+def janitor_stale_instances(registry, stale_after_s: float = DEFAULT_STALE_S,
+                            repair: bool = True) -> List[dict]:
+    """Fail INIT/TRAINING rows whose liveness signal went stale.
+
+    A row is stale when its heartbeat — or, if the trainer died before
+    the first beat, its start_time — is older than `stale_after_s`.
+    With `repair`, stale rows become FAILED so deploy's
+    `get_latest_completed` resolution can't pick up a ghost.
+    """
+    m = _metrics()
+    findings: List[dict] = []
+    instances = registry.get_meta_data_engine_instances()
+    cutoff = utcnow() - timedelta(seconds=stale_after_s)
+    live = (EngineInstanceStatus.INIT, EngineInstanceStatus.TRAINING)
+    for row in instances.get_all():
+        if row.status not in live:
+            continue
+        last = row.heartbeat or row.start_time
+        if _aware(last) >= cutoff:
+            continue
+        age = (utcnow() - _aware(last)).total_seconds()
+        finding = {"kind": "stale_instance", "id": row.id,
+                   "status": row.status,
+                   "reason": f"no heartbeat for {age:.0f}s",
+                   "action": "none"}
+        if repair:
+            instances.update(row.with_(
+                status=EngineInstanceStatus.FAILED, end_time=utcnow()))
+            m["janitor"].inc()
+            finding["action"] = "marked FAILED"
+        findings.append(finding)
+    return findings
+
+
+def doctor(registry, repair: bool = False,
+           stale_after_s: float = DEFAULT_STALE_S) -> Dict[str, object]:
+    """The `pio doctor` report: fsck + janitor + breaker states."""
+    store_findings = fsck_registry(registry, repair=repair)
+    janitor_findings = janitor_stale_instances(
+        registry, stale_after_s=stale_after_s, repair=repair)
+    unrepaired = [
+        f for f in store_findings + janitor_findings
+        if f.get("action", "none") == "none"]
+    return {
+        "fsck": store_findings,
+        "janitor": janitor_findings,
+        "breakers": registry.breaker_states(),
+        "repair": repair,
+        "unrepaired": len(unrepaired),
+    }
+
+
+def _truthy(value: Optional[str], default: bool = True) -> bool:
+    if value is None:
+        return default
+    return str(value).lower() not in ("off", "0", "false", "no", "")
+
+
+def startup_check(registry, log=None) -> Optional[Dict[str, object]]:
+    """Server-boot recovery pass: fsck in report-only mode (repairs are
+    an explicit operator action via `pio doctor --repair`), janitor
+    acting (a stale row is unambiguous and blocking). Gated by
+    `PIO_FSCK_ON_STARTUP` / `PIO_JANITOR`; never raises — a damaged
+    store must not stop a server that can still serve."""
+    cfg = getattr(registry, "config", {}) or {}
+    if not _truthy(cfg.get("PIO_FSCK_ON_STARTUP")):
+        return None
+    try:
+        stale_s = float(cfg.get("PIO_JANITOR_STALE_S", DEFAULT_STALE_S))
+        report = {
+            "fsck": fsck_registry(registry, repair=False),
+            "janitor": (janitor_stale_instances(registry, stale_s, True)
+                        if _truthy(cfg.get("PIO_JANITOR")) else []),
+        }
+    except (StorageError, OSError) as exc:
+        if log is not None:
+            log("fsck.startup.error", error=str(exc))
+        return None
+    if log is not None and (report["fsck"] or report["janitor"]):
+        log("fsck.startup",
+            findings=len(report["fsck"]), janitor=len(report["janitor"]))
+    return report

@@ -1,0 +1,180 @@
+"""Blob integrity envelope + crash-safe file writes.
+
+Every model blob persisted by a storage driver is wrapped in a small
+versioned envelope carrying a checksum so that corruption (torn write,
+bit rot, truncation) surfaces as a typed :class:`CorruptBlobError` at
+read time instead of an opaque unpickling traceback at deploy time.
+
+Envelope layout (little-endian)::
+
+    offset  size  field
+    0       4     magic  b"PIOB"
+    4       1     format version (1)
+    5       1     digest algo (1=CRC32, 2=SHA-256)
+    6       8     payload length (uint64)
+    14      D     digest (4 bytes for CRC32, 32 for SHA-256)
+    14+D    N     payload
+
+Blobs that do not start with the magic are treated as legacy
+(pre-envelope) payloads and pass through unchanged, so stores written
+before this module existed remain readable.
+
+:func:`atomic_write_bytes` is the single sanctioned way to write files
+under ``data/storage/`` (enforced by the lint gate): unique tmp file →
+fsync(file) → rename → fsync(dir), so a crash at any point leaves either
+the old content or the new content, never a torn file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import uuid
+import zlib
+from pathlib import Path
+from typing import Optional, Tuple, Union
+
+from predictionio_tpu.data.storage.base import StorageError
+
+BLOB_MAGIC = b"PIOB"
+FORMAT_VERSION = 1
+ALGO_CRC32 = 1
+ALGO_SHA256 = 2
+_HEADER = struct.Struct("<4sBBQ")  # magic, version, algo, payload length
+_DIGEST_SIZE = {ALGO_CRC32: 4, ALGO_SHA256: 32}
+
+
+class CorruptBlobError(StorageError):
+    """An enveloped blob failed its integrity check (torn/corrupt)."""
+
+
+def _digest(payload: bytes, algo: int) -> bytes:
+    if algo == ALGO_CRC32:
+        return struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+    if algo == ALGO_SHA256:
+        return hashlib.sha256(payload).digest()
+    raise CorruptBlobError(f"unknown digest algo {algo}")
+
+
+def wrap(payload: bytes, algo: int = ALGO_SHA256) -> bytes:
+    """Wrap *payload* in a checksummed envelope."""
+    if algo not in _DIGEST_SIZE:
+        raise ValueError(f"unknown digest algo {algo}")
+    header = _HEADER.pack(BLOB_MAGIC, FORMAT_VERSION, algo, len(payload))
+    return header + _digest(payload, algo) + payload
+
+
+def is_enveloped(blob: bytes) -> bool:
+    return blob[:4] == BLOB_MAGIC
+
+
+def verify(blob: bytes) -> Tuple[bool, str]:
+    """Non-raising integrity check → ``(ok, reason)``.
+
+    Legacy (non-enveloped) blobs verify OK with reason ``"legacy"``.
+    """
+    if not is_enveloped(blob):
+        return True, "legacy"
+    try:
+        unwrap(blob)
+    except CorruptBlobError as exc:
+        return False, str(exc)
+    return True, "ok"
+
+
+def unwrap(blob: bytes) -> bytes:
+    """Return the payload of an enveloped blob, verifying its digest.
+
+    Non-enveloped blobs are returned unchanged (legacy compatibility).
+    Raises :class:`CorruptBlobError` on any structural or digest
+    mismatch.
+    """
+    if not is_enveloped(blob):
+        return blob
+    if len(blob) < _HEADER.size:
+        raise CorruptBlobError("truncated envelope header")
+    magic, version, algo, length = _HEADER.unpack_from(blob)
+    if version != FORMAT_VERSION:
+        raise CorruptBlobError(f"unsupported envelope version {version}")
+    dsize = _DIGEST_SIZE.get(algo)
+    if dsize is None:
+        raise CorruptBlobError(f"unknown digest algo {algo}")
+    body_start = _HEADER.size + dsize
+    if len(blob) != body_start + length:
+        raise CorruptBlobError(
+            f"length mismatch: header says {length}, "
+            f"have {len(blob) - body_start}"
+        )
+    digest = blob[_HEADER.size:body_start]
+    payload = blob[body_start:]
+    if _digest(payload, algo) != digest:
+        raise CorruptBlobError("digest mismatch")
+    return payload
+
+
+def atomic_write_bytes(path: Union[str, Path], data: bytes) -> None:
+    """Crash-safe write: unique tmp → fsync → rename → fsync(dir)."""
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.{uuid.uuid4().hex}.tmp")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        raise
+    _fsync_dir(path.parent)
+
+
+def atomic_write_text(path: Union[str, Path], text: str,
+                      encoding: str = "utf-8") -> None:
+    atomic_write_bytes(path, text.encode(encoding))
+
+
+def _fsync_dir(dirpath: Path) -> None:
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return  # platform without dir-open support
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def purge_tmp_siblings(path: Path) -> int:
+    """Remove leftover ``<name>.*.tmp`` files next to *path*; returns count."""
+    removed = 0
+    try:
+        siblings = list(path.parent.glob(path.name + ".*.tmp"))
+    except OSError:
+        return 0
+    for tmp in siblings:
+        try:
+            tmp.unlink()
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
+def quarantine_file(path: Path, reason: str,
+                    quarantine_dir: Optional[Path] = None) -> Path:
+    """Move *path* into a ``.quarantine/`` dir, writing a reason sidecar."""
+    qdir = quarantine_dir or (path.parent / ".quarantine")
+    qdir.mkdir(parents=True, exist_ok=True)
+    dest = qdir / path.name
+    if dest.exists():
+        dest = qdir / f"{path.name}.{uuid.uuid4().hex[:8]}"
+    os.replace(path, dest)
+    atomic_write_text(dest.with_name(dest.name + ".reason"), reason + "\n")
+    _fsync_dir(qdir)
+    return dest

@@ -127,6 +127,10 @@ class EngineInstance:
     preparator_params: str = ""
     algorithms_params: str = ""
     serving_params: str = ""
+    # last liveness beat from the training process; the stale-instance
+    # janitor fails INIT/TRAINING rows whose heartbeat (or, if never
+    # beaten, start_time) is older than the staleness threshold
+    heartbeat: Optional[datetime] = None
 
     def with_(self, **kw) -> "EngineInstance":
         return replace(self, **kw)
@@ -261,6 +265,14 @@ class EngineInstances(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, iid: str) -> None: ...
+
+    def record_heartbeat(self, iid: str,
+                         ts: Optional[datetime] = None) -> None:
+        """Refresh the liveness beat on a row (default impl: get+update;
+        drivers may override with a single-column write)."""
+        row = self.get(iid)
+        if row is not None:
+            self.update(row.with_(heartbeat=ts or utcnow()))
 
 
 class EvaluationInstances(abc.ABC):

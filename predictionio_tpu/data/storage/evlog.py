@@ -13,14 +13,18 @@ Config: PIO_STORAGE_SOURCES_<NAME>_TYPE=EVLOG, ..._PATH=<dir>.
 from __future__ import annotations
 
 import json
+import os
+import struct
 import threading
+import zlib
 from datetime import timezone
 from pathlib import Path
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event, datetime
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.native.eventlog import EventLog
+from predictionio_tpu.native.eventlog import MAGIC, EventLog
+from predictionio_tpu.resilience import FaultError, faults
 
 
 class EvlogStorageClient:
@@ -114,8 +118,20 @@ class EvlogEvents(base.EventStore):
             if e.event_id in self._replay(app_id, channel_id):
                 raise base.StorageWriteError(
                     f"Duplicate event id {e.event_id}")
-            EventLog(str(self._path(app_id, channel_id))).append(
-                _event_to_payload(e))
+            path = self._path(app_id, channel_id)
+            payload = _event_to_payload(e)
+            # crash-consistency seam: append only part of the frame (a
+            # mid-write crash on the journal) — fsck must truncate it
+            frac = faults().torn_fraction("evlog.append.partial")
+            if frac is not None:
+                frame = struct.pack(
+                    "<III", MAGIC, len(payload),
+                    zlib.crc32(payload) & 0xFFFFFFFF) + payload
+                with open(path, "ab") as f:
+                    f.write(frame[:int(len(frame) * frac)])
+                raise FaultError("injected torn append at "
+                                 "evlog.append.partial")
+            EventLog(str(path)).append(payload)
             # the replay cache is size-keyed; next read picks up the append
         return e.event_id
 
@@ -131,6 +147,34 @@ class EvlogEvents(base.EventStore):
             EventLog(str(self._path(app_id, channel_id))).append(
                 json.dumps({"$tombstone": event_id}).encode())
         return True
+
+    def fsck(self, repair: bool = False) -> List[dict]:
+        """Detect torn journal tails (trailing bytes past the last valid
+        frame — scans already ignore them, but they hide every FUTURE
+        append). `repair` truncates to the last valid frame boundary."""
+        findings: List[dict] = []
+        for path in sorted(self.c.base_dir.glob("events_*.log")):
+            valid_end = 0
+            for _payload, end in EventLog(str(path)).scan_from(0):
+                valid_end = end
+            try:
+                size = path.stat().st_size
+            except OSError:
+                continue
+            if size <= valid_end:
+                continue
+            finding = {
+                "kind": "torn_tail", "path": str(path),
+                "reason": (f"{size - valid_end} trailing bytes fail "
+                           "frame CRC"),
+                "action": "none"}
+            if repair:
+                with self.c.lock:
+                    os.truncate(path, valid_end)
+                    self.c.cache.pop(str(path), None)
+                finding["action"] = f"truncated to {valid_end}"
+            findings.append(finding)
+        return findings
 
     def find(self, app_id: int, channel_id: Optional[int] = None, *,
              start_time=None, until_time=None, entity_type=None,

@@ -2,16 +2,26 @@
 
 Parity: reference `storage/localfs/.../LocalFSModels.scala:62` — model blobs
 as files `pio_model_<id>` under a configured directory.
+
+Durability: every blob is wrapped in the integrity envelope
+(`data/integrity.py`) and written atomically (tmp → fsync → rename), so
+a crash mid-insert can never leave a torn file under the final name.
+`get` verifies the checksum and raises `CorruptBlobError` on mismatch;
+`fsck` sweeps the directory, quarantining corrupt blobs into
+`.quarantine/` (with a `.reason` sidecar) and clearing orphaned `*.tmp`
+files from interrupted writes.
 """
 
 from __future__ import annotations
 
 import os
 from pathlib import Path
-from typing import Optional
+from typing import List, Optional
 
+from predictionio_tpu.data import integrity
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.resilience import FaultError, faults
 
 
 class LocalFSStorageClient:
@@ -20,6 +30,7 @@ class LocalFSStorageClient:
         path = self.config.get("PATH", self.config.get("path", "~/.pio_store/models"))
         self.path = Path(os.path.expanduser(path))
         self.path.mkdir(parents=True, exist_ok=True)
+        self.source_name = self.config.get("SOURCE_NAME", "LOCALFS")
 
 
 class LocalFSModels(base.Models):
@@ -31,15 +42,57 @@ class LocalFSModels(base.Models):
         return self.c.path / f"pio_model_{safe}"
 
     def insert(self, m: Model) -> None:
-        self._file(m.id).write_bytes(m.models)
+        wrapped = integrity.wrap(m.models)
+        path = self._file(m.id)
+        # crash-consistency seam: when a torn-write fault is armed, only
+        # a fraction of the bytes reach the final path (simulating a
+        # crash mid-write on a non-atomic store) and the "process dies"
+        seam = f"storage.{self.c.source_name}.models.insert.torn"
+        frac = faults().torn_fraction(seam)
+        if frac is not None:
+            path.write_bytes(wrapped[:int(len(wrapped) * frac)])  # lint: ok
+            raise FaultError(f"injected torn write at {seam}")
+        integrity.atomic_write_bytes(path, wrapped)
 
     def get(self, mid: str) -> Optional[Model]:
         f = self._file(mid)
         if not f.exists():
             return None
-        return Model(mid, f.read_bytes())
+        return Model(mid, integrity.unwrap(f.read_bytes()))
 
     def delete(self, mid: str) -> None:
         f = self._file(mid)
         if f.exists():
             f.unlink()
+        integrity.purge_tmp_siblings(f)
+
+    def fsck(self, repair: bool = False) -> List[dict]:
+        """Scan all blobs; quarantine corrupt ones and purge orphaned
+        tmp files when `repair` is set. Returns finding dicts."""
+        findings: List[dict] = []
+        for f in sorted(self.c.path.glob("pio_model_*")):
+            if f.name.endswith(".tmp"):
+                finding = {"kind": "tmp_orphan", "path": str(f),
+                           "reason": "leftover tmp from interrupted write",
+                           "action": "none"}
+                if repair:
+                    try:
+                        f.unlink()
+                        finding["action"] = "removed"
+                    except OSError as exc:
+                        finding["action"] = f"remove failed: {exc}"
+                findings.append(finding)
+                continue
+            try:
+                ok, reason = integrity.verify(f.read_bytes())
+            except OSError as exc:
+                ok, reason = False, f"unreadable: {exc}"
+            if ok:
+                continue
+            finding = {"kind": "corrupt_blob", "path": str(f),
+                       "reason": reason, "action": "none"}
+            if repair:
+                dest = integrity.quarantine_file(f, reason)
+                finding["action"] = f"quarantined -> {dest}"
+            findings.append(finding)
+        return findings

@@ -23,8 +23,9 @@ if it is absent.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
+from predictionio_tpu.data import integrity
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model, StorageError
 
@@ -82,17 +83,53 @@ class ObjectStoreModels(base.Models):
         return f"{self.c.root}/pio_model_{quote(mid, safe='')}"
 
     def insert(self, m: Model) -> None:
+        # object stores commit a PUT atomically on close; the envelope
+        # still detects any partially-replicated / bit-rotted object
         with self.c.fs.open(self._key(m.id), "wb") as f:
-            f.write(m.models)
+            f.write(integrity.wrap(m.models))
 
     def get(self, mid: str) -> Optional[Model]:
         key = self._key(mid)
         if not self.c.fs.exists(key):
             return None
         with self.c.fs.open(key, "rb") as f:
-            return Model(mid, f.read())
+            return Model(mid, integrity.unwrap(f.read()))
 
     def delete(self, mid: str) -> None:
         key = self._key(mid)
         if self.c.fs.exists(key):
             self.c.fs.rm(key)
+
+    def fsck(self, repair: bool = False) -> List[dict]:
+        """Verify every `pio_model_*` object; corrupt ones move under
+        `<root>/.quarantine/` with a `.reason` sidecar object."""
+        fs, root = self.c.fs, self.c.root
+        findings: List[dict] = []
+        try:
+            keys = sorted(k for k in fs.ls(root, detail=False)
+                          if k.rsplit("/", 1)[-1].startswith("pio_model_"))
+        except FileNotFoundError:
+            return findings
+        for key in keys:
+            try:
+                with fs.open(key, "rb") as f:
+                    ok, reason = integrity.verify(f.read())
+            except OSError as exc:
+                ok, reason = False, f"unreadable: {exc}"
+            if ok:
+                continue
+            finding = {"kind": "corrupt_blob", "path": key,
+                       "reason": reason, "action": "none"}
+            if repair:
+                name = key.rsplit("/", 1)[-1]
+                dest = f"{root}/.quarantine/{name}"
+                try:
+                    fs.makedirs(f"{root}/.quarantine", exist_ok=True)
+                except Exception:
+                    pass  # flat namespaces (s3) have no directories
+                fs.mv(key, dest)
+                with fs.open(dest + ".reason", "wb") as f:
+                    f.write((reason + "\n").encode())
+                finding["action"] = f"quarantined -> {dest}"
+            findings.append(finding)
+        return findings

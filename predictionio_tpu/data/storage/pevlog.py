@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import re
 import threading
 from base64 import b64decode, b64encode
@@ -62,6 +63,7 @@ from datetime import datetime, timezone
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from predictionio_tpu.data import integrity
 from predictionio_tpu.data.event import DataMap, Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.evlog import (
@@ -534,9 +536,8 @@ def _persist_index(seg_path: Path, ix: _SegmentIndex) -> None:
     # offsets, not stat(): a concurrent writer may have grown the file
     # past what this index has seen)
     ix.synced = ix.mem_size
-    tmp = seg_path.with_suffix(".idx.tmp")
-    tmp.write_text(json.dumps(ix.dump()))
-    tmp.replace(seg_path.with_suffix(".idx"))
+    integrity.atomic_write_bytes(seg_path.with_suffix(".idx"),
+                                 json.dumps(ix.dump()).encode())
 
 
 # generated ids are <16-hex bucket>-<32-hex uuid4>; anything else is an
@@ -786,6 +787,67 @@ class PevlogEvents(base.EventStore):
 
     def close(self) -> None:
         self.c.close()
+
+    def fsck(self, repair: bool = False) -> List[dict]:
+        """Partition-wide consistency sweep: (1) torn tails on every
+        CRC-framed journal (segments, tombstones, external ids) — scans
+        already ignore them but they hide future appends; (2) stale or
+        missing segment sidecar indexes (crash between append and index
+        flush). Repair truncates tails and rebuilds indexes from the
+        journal (source of truth)."""
+        # flush this process's own batched index state first: on a LIVE
+        # store, dirty in-memory indexes make sidecars look stale when
+        # nothing is actually wrong
+        self.c.close()
+        findings: List[dict] = []
+        for part in sorted(self.c.base_dir.glob("app_*")):
+            if not part.is_dir():
+                continue
+            for jpath in sorted(part.glob("*.log")):
+                valid_end = 0
+                for _payload, end in EventLog(str(jpath)).scan_from(0):
+                    valid_end = end
+                try:
+                    size = jpath.stat().st_size
+                except OSError:
+                    continue
+                if size > valid_end:
+                    finding = {
+                        "kind": "torn_tail", "path": str(jpath),
+                        "reason": (f"{size - valid_end} trailing bytes "
+                                   "fail frame CRC"),
+                        "action": "none"}
+                    if repair:
+                        with self.c.lock:
+                            os.truncate(jpath, valid_end)
+                            self.c.replay_cache.pop(str(jpath), None)
+                            self.c.index_cache.pop(str(jpath), None)
+                        finding["action"] = f"truncated to {valid_end}"
+                    findings.append(finding)
+            for seg in self._segments(part):
+                idx_path = seg.with_suffix(".idx")
+                size = seg.stat().st_size if seg.exists() else 0
+                synced = -1
+                if idx_path.exists():
+                    try:
+                        synced = _SegmentIndex.load(
+                            json.loads(idx_path.read_text())).synced
+                    except (ValueError, KeyError):
+                        synced = -1
+                if synced == size:
+                    continue
+                finding = {
+                    "kind": "stale_index", "path": str(idx_path),
+                    "reason": (f"sidecar covers {max(synced, 0)} of "
+                               f"{size} journal bytes"),
+                    "action": "none"}
+                if repair:
+                    with self.c.lock:
+                        self.c.index_cache.pop(str(seg), None)
+                        self._index(seg)   # rebuild/extend + persist
+                    finding["action"] = "rebuilt"
+                findings.append(finding)
+        return findings
 
     def _insert(self, event: Event, app_id: int,
                 channel_id: Optional[int] = None) -> str:

@@ -27,7 +27,9 @@ from predictionio_tpu.data.storage.base import (
     StorageError, TRANSIENT_STORAGE_ERRORS,
 )
 from predictionio_tpu.data.storage.resilient import ResilientDAO
-from predictionio_tpu.resilience import CircuitBreaker, RetryPolicy
+from predictionio_tpu.resilience import (
+    CircuitBreaker, RetryBudget, RetryPolicy,
+)
 
 
 # type name -> (client factory, {dao role -> DAO class name on module})
@@ -150,6 +152,7 @@ class StorageRegistry:
         self._clients: Dict[str, object] = {}
         self._daos: Dict[Tuple[str, str], object] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
+        self._budgets: Dict[str, Optional[RetryBudget]] = {}
         self.sources, self.repositories = self._parse(self.config)
 
     @staticmethod
@@ -200,7 +203,10 @@ class StorageRegistry:
             if source_name not in self._clients:
                 if source_name not in self.sources:
                     raise StorageError(f"Undefined storage source: {source_name}")
-                scfg = self.sources[source_name]
+                scfg = dict(self.sources[source_name])
+                # drivers see their own source name (chaos seams, fsck
+                # reports, and quarantine metrics are labelled with it)
+                scfg.setdefault("SOURCE_NAME", source_name)
                 driver = DRIVERS[scfg["TYPE"].upper()]
                 if scfg["TYPE"].upper() == "SQLITE" and "PATH" in scfg:
                     Path(scfg["PATH"]).expanduser().parent.mkdir(
@@ -232,7 +238,9 @@ class StorageRegistry:
         """Per-source resilience knobs (all optional, via
         PIO_STORAGE_SOURCES_<N>_*): RESILIENCE=off disables wrapping;
         RETRY_ATTEMPTS / RETRY_BASE_DELAY tune the retry schedule;
-        BREAKER_THRESHOLD / BREAKER_RECOVERY_S tune the breaker."""
+        BREAKER_THRESHOLD / BREAKER_RECOVERY_S tune the breaker;
+        RETRY_BUDGET caps aggregate retry amplification (tokens,
+        0/off disables)."""
         if str(scfg.get("RESILIENCE", "on")).lower() in (
                 "off", "0", "false", "no"):
             return dao
@@ -242,7 +250,8 @@ class StorageRegistry:
             retryable=TRANSIENT_STORAGE_ERRORS)
         return ResilientDAO(
             dao, seam=f"storage.{source}.{dao_name}", source=source,
-            breaker=self._breaker(source, scfg), policy=policy)
+            breaker=self._breaker(source, scfg), policy=policy,
+            budget=self._budget(source, scfg))
 
     def _breaker(self, source: str, scfg: Mapping[str, str]) -> CircuitBreaker:
         breaker = self._breakers.get(source)
@@ -253,6 +262,19 @@ class StorageRegistry:
                 recovery_time=float(scfg.get("BREAKER_RECOVERY_S", 30.0)))
             self._breakers[source] = breaker
         return breaker
+
+    def _budget(self, source: str,
+                scfg: Mapping[str, str]) -> Optional[RetryBudget]:
+        """One shared retry budget per source (all its DAOs draw from
+        the same bucket — amplification is a per-backend phenomenon)."""
+        if source in self._budgets:
+            return self._budgets[source]
+        raw = str(scfg.get("RETRY_BUDGET", "50")).lower()
+        budget: Optional[RetryBudget] = None
+        if raw not in ("off", "0", "false", "no", "none", ""):
+            budget = RetryBudget(capacity=float(raw))
+        self._budgets[source] = budget
+        return budget
 
     def breaker_states(self) -> Dict[str, str]:
         """Current breaker state per active source ('closed' / 'open' /

@@ -35,7 +35,7 @@ from typing import Callable, Dict, Optional
 from predictionio_tpu.data.storage.base import TRANSIENT_STORAGE_ERRORS
 from predictionio_tpu.obs import MetricsRegistry, get_registry
 from predictionio_tpu.resilience import (
-    CircuitBreaker, RetryPolicy, call_with_retry, faults,
+    CircuitBreaker, RetryBudget, RetryPolicy, call_with_retry, faults,
 )
 
 
@@ -44,17 +44,23 @@ class ResilientDAO:
 
     def __init__(self, dao: object, seam: str, source: str,
                  breaker: CircuitBreaker, policy: RetryPolicy,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 budget: Optional[RetryBudget] = None):
         self._dao = dao
         self._seam = seam          # "storage.<source>.<dao>"
         self._source = source
         self._breaker = breaker
         self._policy = policy
+        self._budget = budget      # shared per-source; None = unbudgeted
         self._wrapped: Dict[str, Callable] = {}
         metrics = metrics if metrics is not None else get_registry()
         self._retries = metrics.counter(
             "pio_storage_retries_total",
             "Storage operations retried after a transient failure",
+            labels=("source",))
+        self._budget_exhausted = metrics.counter(
+            "pio_retry_budget_exhausted_total",
+            "Retries abandoned because the per-source retry budget ran dry",
             labels=("source",))
 
     def __getattr__(self, name: str):
@@ -79,6 +85,12 @@ class ResilientDAO:
         policy = self._policy
 
         def on_retry(attempt, exc, delay):
+            budget = self._budget
+            if budget is not None and not budget.try_acquire():
+                # budget dry: abandon the retry loop, surface the
+                # original error (raising from on_retry aborts retry)
+                self._budget_exhausted.labels(source=self._source).inc()
+                raise exc
             self._retries.labels(source=self._source).inc()
 
         def attempt(*args, **kwargs):

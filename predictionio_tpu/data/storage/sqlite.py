@@ -51,7 +51,7 @@ META_DDL = (
         enginevariant TEXT, enginefactory TEXT, batch TEXT,
         env TEXT, runtimeconf TEXT, datasourceparams TEXT,
         preparatorparams TEXT, algorithmsparams TEXT,
-        servingparams TEXT)""",
+        servingparams TEXT, heartbeat INTEGER)""",
     """CREATE TABLE IF NOT EXISTS evaluation_instances (
         id TEXT PRIMARY KEY, status TEXT, starttime INTEGER,
         endtime INTEGER, evaluationclass TEXT,
@@ -60,6 +60,16 @@ META_DDL = (
         evaluatorresultshtml TEXT, evaluatorresultsjson TEXT)""",
     """CREATE TABLE IF NOT EXISTS models (
         id TEXT PRIMARY KEY, models BLOB)""",
+    """CREATE TABLE IF NOT EXISTS models_quarantine (
+        id TEXT PRIMARY KEY, models BLOB, reason TEXT)""",
+)
+
+# Additive schema migrations for stores created before a column existed;
+# each statement is applied best-effort (duplicate-column errors from
+# already-migrated stores are swallowed). Postgres runs the same list
+# through its dialect translation.
+META_MIGRATIONS = (
+    "ALTER TABLE engine_instances ADD COLUMN heartbeat INTEGER",
 )
 
 
@@ -82,6 +92,12 @@ class SQLiteStorageClient:
         with self.lock, self.conn:
             for ddl in META_DDL:
                 self.conn.execute(ddl)
+        for mig in META_MIGRATIONS:
+            try:
+                with self.lock, self.conn:
+                    self.conn.execute(mig)
+            except sqlite3.OperationalError:
+                pass  # column already exists (fresh DDL or prior migration)
 
     def close(self) -> None:
         with self.lock:
@@ -234,7 +250,8 @@ class SQLiteChannels(base.Channels):
 class SQLiteEngineInstances(base.EngineInstances):
     COLS = ("id, status, starttime, endtime, engineid, engineversion, "
             "enginevariant, enginefactory, batch, env, runtimeconf, "
-            "datasourceparams, preparatorparams, algorithmsparams, servingparams")
+            "datasourceparams, preparatorparams, algorithmsparams, "
+            "servingparams, heartbeat")
 
     def __init__(self, client: SQLiteStorageClient):
         self.c = client
@@ -244,7 +261,8 @@ class SQLiteEngineInstances(base.EngineInstances):
                 i.engine_id, i.engine_version, i.engine_variant,
                 i.engine_factory, i.batch, json.dumps(dict(i.env)),
                 json.dumps(dict(i.runtime_conf)), i.data_source_params,
-                i.preparator_params, i.algorithms_params, i.serving_params)
+                i.preparator_params, i.algorithms_params, i.serving_params,
+                to_millis(i.heartbeat) if i.heartbeat is not None else None)
 
     @staticmethod
     def _from_row(r) -> EngineInstance:
@@ -254,7 +272,8 @@ class SQLiteEngineInstances(base.EngineInstances):
             engine_variant=r[6], engine_factory=r[7], batch=r[8],
             env=json.loads(r[9]), runtime_conf=json.loads(r[10]),
             data_source_params=r[11], preparator_params=r[12],
-            algorithms_params=r[13], serving_params=r[14])
+            algorithms_params=r[13], serving_params=r[14],
+            heartbeat=from_millis(r[15]) if r[15] is not None else None)
 
     def insert(self, i: EngineInstance) -> str:
         iid = i.id or uuid.uuid4().hex
@@ -262,7 +281,7 @@ class SQLiteEngineInstances(base.EngineInstances):
         with self.c.lock, self.c.conn:
             self.c.conn.execute(
                 f"INSERT INTO engine_instances ({self.COLS}) VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)", self._to_row(i))
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)", self._to_row(i))
         return iid
 
     def get(self, iid: str) -> Optional[EngineInstance]:
@@ -298,8 +317,8 @@ class SQLiteEngineInstances(base.EngineInstances):
                 "UPDATE engine_instances SET status=?, starttime=?, endtime=?, "
                 "engineid=?, engineversion=?, enginevariant=?, enginefactory=?, "
                 "batch=?, env=?, runtimeconf=?, datasourceparams=?, "
-                "preparatorparams=?, algorithmsparams=?, servingparams=? "
-                "WHERE id=?", self._to_row(i)[1:] + (i.id,))
+                "preparatorparams=?, algorithmsparams=?, servingparams=?, "
+                "heartbeat=? WHERE id=?", self._to_row(i)[1:] + (i.id,))
 
     def delete(self, iid: str) -> None:
         with self.c.lock, self.c.conn:
@@ -377,24 +396,54 @@ class SQLiteEvaluationInstances(base.EvaluationInstances):
 
 
 class SQLiteModels(base.Models):
+    """Model blobs are stored wrapped in the integrity envelope; `get`
+    verifies the checksum (CorruptBlobError on mismatch), `fsck` moves
+    corrupt rows into the `models_quarantine` table with a reason."""
+
     def __init__(self, client: SQLiteStorageClient):
         self.c = client
 
     def insert(self, m: Model) -> None:
+        from predictionio_tpu.data import integrity
         with self.c.lock, self.c.conn:
             self.c.conn.execute(
                 "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
-                (m.id, m.models))
+                (m.id, integrity.wrap(m.models)))
 
     def get(self, mid: str) -> Optional[Model]:
+        from predictionio_tpu.data import integrity
         with self.c.lock:
             row = self.c.conn.execute(
                 "SELECT id, models FROM models WHERE id=?", (mid,)).fetchone()
-        return Model(row[0], row[1]) if row else None
+        return Model(row[0], integrity.unwrap(bytes(row[1]))) if row else None
 
     def delete(self, mid: str) -> None:
         with self.c.lock, self.c.conn:
             self.c.conn.execute("DELETE FROM models WHERE id=?", (mid,))
+
+    def fsck(self, repair: bool = False) -> List[dict]:
+        from predictionio_tpu.data import integrity
+        findings: List[dict] = []
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id, models FROM models ORDER BY id").fetchall()
+        for mid, blob in rows:
+            ok, reason = integrity.verify(bytes(blob))
+            if ok:
+                continue
+            finding = {"kind": "corrupt_blob", "id": mid,
+                       "reason": reason, "action": "none"}
+            if repair:
+                with self.c.lock, self.c.conn:
+                    self.c.conn.execute(
+                        "INSERT OR REPLACE INTO models_quarantine "
+                        "(id, models, reason) VALUES (?,?,?)",
+                        (mid, blob, reason))
+                    self.c.conn.execute(
+                        "DELETE FROM models WHERE id=?", (mid,))
+                finding["action"] = "quarantined -> models_quarantine"
+            findings.append(finding)
+        return findings
 
 
 class SQLiteEvents(base.EventStore):

@@ -8,6 +8,7 @@ the serve chain, and every storage backend:
 
   deadline.py  X-PIO-Deadline-Ms propagation, 504 on expiry
   retry.py     bounded exponential backoff + jitter, deadline-aware
+  budget.py    per-source retry budgets capping retry amplification
   breaker.py   half-open circuit breaker, state on /metrics and /ready
   shed.py      bounded admission (503/429 + Retry-After), shed counters
   faults.py    deterministic chaos harness driving the seams above
@@ -24,6 +25,9 @@ from predictionio_tpu.resilience.deadline import (  # noqa: F401
 )
 from predictionio_tpu.resilience.retry import (  # noqa: F401
     RetryPolicy, call_with_retry, retry,
+)
+from predictionio_tpu.resilience.budget import (  # noqa: F401
+    RetryBudget,
 )
 from predictionio_tpu.resilience.breaker import (  # noqa: F401
     CircuitBreaker, CircuitOpenError,

@@ -8,6 +8,11 @@ test arms a rule. Rules inject, deterministically:
   - exceptions (an instance, or a type to instantiate per hit)
   - N-then-succeed (`times=N`: the first N hits fire, the rest pass —
     the storage-flake shape that retry must absorb)
+  - torn writes (`torn=0.6`: crash-consistency seams call
+    `torn_fraction(seam)` and, when armed, persist only that fraction
+    of the bytes before raising — simulating a mid-write crash without
+    killing the process; see `storage.<source>.models.insert.torn` and
+    `evlog.append.partial`)
 
 Seams are matched by dotted-prefix: a rule armed at ``storage.PIO``
 hits ``storage.PIO.Events.insert`` and every sibling. Standard seams:
@@ -40,15 +45,17 @@ class FaultError(Exception):
 class FaultRule:
     """One armed fault; mutable hit counter, guarded by the injector."""
 
-    __slots__ = ("seam", "latency", "error", "times", "hits")
+    __slots__ = ("seam", "latency", "error", "times", "hits", "torn")
 
     def __init__(self, seam: str, latency: float = 0.0,
                  error: Union[BaseException, type, None] = None,
-                 times: Optional[int] = None):
+                 times: Optional[int] = None,
+                 torn: Optional[float] = None):
         self.seam = seam
         self.latency = latency
         self.error = error
         self.times = times           # None = every hit
+        self.torn = torn             # fraction of bytes persisted, or None
         self.hits = 0
 
     def matches(self, seam: str) -> bool:
@@ -69,10 +76,13 @@ class FaultInjector:
 
     def arm(self, seam: str, *, latency: float = 0.0,
             error: Union[BaseException, type, None] = None,
-            times: Optional[int] = None) -> FaultRule:
+            times: Optional[int] = None,
+            torn: Optional[float] = None) -> FaultRule:
         """Arm a rule at `seam` (dotted-prefix matched). Returns the rule
-        so tests can inspect `rule.hits`."""
-        rule = FaultRule(seam, latency=latency, error=error, times=times)
+        so tests can inspect `rule.hits`. Rules with `torn=` set fire
+        only via `torn_fraction()`, never via `check()`."""
+        rule = FaultRule(seam, latency=latency, error=error, times=times,
+                         torn=torn)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -92,6 +102,8 @@ class FaultInjector:
         fired: List[FaultRule] = []
         with self._lock:
             for rule in self._rules:
+                if rule.torn is not None:   # torn rules fire via torn_fraction
+                    continue
                 if rule.matches(seam) and not rule.exhausted():
                     rule.hits += 1
                     fired.append(rule)
@@ -104,6 +116,25 @@ class FaultInjector:
                 if isinstance(err, type):
                     err = err(f"injected fault at {seam}")
                 raise err
+
+    def torn_fraction(self, seam: str) -> Optional[float]:
+        """Torn-write seam entry point: returns the fraction of bytes the
+        caller should persist before simulating a crash, or None when no
+        torn rule matches. Counts as an injection when armed."""
+        if not self._rules:
+            return None
+        frac: Optional[float] = None
+        with self._lock:
+            for rule in self._rules:
+                if rule.torn is None:
+                    continue
+                if rule.matches(seam) and not rule.exhausted():
+                    rule.hits += 1
+                    frac = rule.torn
+                    break
+        if frac is not None:
+            self._count(seam)
+        return frac
 
     def _count(self, seam: str) -> None:
         if self._counter is None:

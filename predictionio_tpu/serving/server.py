@@ -386,6 +386,11 @@ class PredictionServer(HTTPServerBase):
         if config.feedback:
             threading.Thread(target=self._drain_feedback,
                              daemon=True).start()
+        # restart-recovery pass BEFORE the first model load: report-only
+        # fsck + acting janitor, so a crashed train's ghost row can't
+        # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables)
+        from predictionio_tpu.data.fsck import startup_check
+        startup_check(self.ctx.registry, log=_log.warning)
         self._load(instance)
         self._routes()
 

@@ -105,30 +105,66 @@ class Dashboard(HTTPServerBase):
                             headers=CORS_HEADERS)
 
 
+# metric-family prefixes surfaced in the durability summary panel: the
+# operator-facing "is the store healthy" view (breaker trips, fsck
+# findings, janitored instances, exhausted retry budgets)
+_DURABILITY_PREFIXES = ("pio_breaker", "pio_fsck", "pio_janitor",
+                        "pio_retry_budget")
+
+
+def _series_rows(name: str, fam: dict) -> list:
+    rows = []
+    for s in fam["series"]:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(
+            s["labels"].items()))
+        if fam["type"] == "histogram":
+            val = (f"count={s['count']} sum={s['sum']:.6g} "
+                   f"p50={s['p50']:.6g} p90={s['p90']:.6g} "
+                   f"p99={s['p99']:.6g}")
+        else:
+            val = f"{s['value']:.6g}"
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{html.escape(labels)}</td>"
+            f"<td>{html.escape(fam['type'])}</td>"
+            f"<td>{html.escape(val)}</td></tr>")
+    return rows
+
+
+def _durability_panel(snapshot: dict) -> str:
+    """Summary table of the resilience/durability families so an operator
+    sees breaker trips, fsck quarantines, janitored trains, and exhausted
+    retry budgets without scanning the full registry dump."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_DURABILITY_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Durability &amp; resilience</h2>"
+                "<p>No breaker/fsck/janitor/retry-budget activity "
+                "recorded yet.</p>")
+    return ("<h2>Durability &amp; resilience</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
+
+
 def _metrics_page(metrics: MetricsRegistry) -> str:
     """Registry snapshot as an auto-refreshing HTML table: counters and
     gauges show their value, histograms show count/sum and the estimated
-    p50/p90/p99 (the same numbers /metrics exposes to a scraper)."""
+    p50/p90/p99 (the same numbers /metrics exposes to a scraper), with a
+    durability summary panel (breakers, fsck, janitor, retry budgets) on
+    top."""
+    snapshot = metrics.snapshot()
     rows = []
-    for name, fam in sorted(metrics.snapshot().items()):
-        for s in fam["series"]:
-            labels = ",".join(f"{k}={v}" for k, v in sorted(
-                s["labels"].items()))
-            if fam["type"] == "histogram":
-                val = (f"count={s['count']} sum={s['sum']:.6g} "
-                       f"p50={s['p50']:.6g} p90={s['p90']:.6g} "
-                       f"p99={s['p99']:.6g}")
-            else:
-                val = f"{s['value']:.6g}"
-            rows.append(
-                f"<tr><td>{html.escape(name)}</td>"
-                f"<td>{html.escape(labels)}</td>"
-                f"<td>{html.escape(fam['type'])}</td>"
-                f"<td>{html.escape(val)}</td></tr>")
+    for name, fam in sorted(snapshot.items()):
+        rows.extend(_series_rows(name, fam))
     return (
         "<html><head><title>Metrics</title>"
         "<meta http-equiv='refresh' content='5'></head>"
         "<body><h1>Live metrics</h1>"
         "<p>Prometheus text format: <a href='/metrics'>/metrics</a></p>"
+        + _durability_panel(snapshot) +
+        "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
         "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")

@@ -22,6 +22,11 @@ Checks, per source file:
     bound, see predictionio_tpu.resilience.Deadline) nor ``time.sleep``
     (hand-rolled retry pacing: use resilience.call_with_retry, which is
     jittered, bounded, and deadline-aware)
+  - storage drivers (data/storage/) must not ``.write_bytes(`` /
+    ``.write_text(`` a durable path directly — a crash mid-write leaves
+    a torn file; go through ``data.integrity.atomic_write_bytes`` (tmp +
+    fsync + rename). Lines mentioning ``.tmp`` (the staging file of the
+    atomic pattern itself) or marked ``# lint: ok`` are allowed
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -46,6 +51,9 @@ _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
 # layers whose telemetry must flow through predictionio_tpu.obs
 _OBS_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
              "predictionio_tpu/core/")
+
+# storage drivers: every durable write must be crash-atomic
+_STORAGE_DIRS = ("predictionio_tpu/data/storage/",)
 
 # layers where unbounded waits and ad-hoc sleep loops are forbidden —
 # everything on a request or storage path must finish or fail in
@@ -211,6 +219,33 @@ def _check_bounded_waits(tree: ast.AST, text: str,
                    "legitimate fixed waits")
 
 
+def _check_storage_writes(tree: ast.AST, text: str,
+                          rel: str) -> Iterator[str]:
+    """In data/storage/: forbid direct ``.write_bytes()``/``.write_text()``
+    — a crash between open and close leaves a torn durable file that the
+    next reader trips over. The atomic pattern (integrity.atomic_write_
+    bytes: unique tmp, fsync, rename, fsync dir) is the sanctioned form.
+    A line naming ``.tmp`` (the staging write inside that very pattern,
+    or an intentionally-torn fault injection) or marked ``# lint: ok``
+    passes."""
+    if not rel.startswith(_STORAGE_DIRS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) \
+                or fn.attr not in ("write_bytes", "write_text"):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line or ".tmp" in line:
+            continue
+        yield (f"{rel}:{node.lineno}: direct .{fn.attr}() in a storage "
+               "driver tears on crash; use "
+               "data.integrity.atomic_write_bytes (or mark '# lint: ok')")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -229,6 +264,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_lines(text, rel))
     out.extend(_check_instrumentation(tree, text, rel))
     out.extend(_check_bounded_waits(tree, text, rel))
+    out.extend(_check_storage_writes(tree, text, rel))
     return out
 
 

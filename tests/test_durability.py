@@ -1,0 +1,554 @@
+"""Durability & crash recovery: the integrity envelope, atomic writes,
+store-wide fsck, the stale-instance janitor, retry budgets, and the
+`pio doctor` surface.
+
+The centerpiece is the chaos scenario the reference stack never tests:
+a torn model write (process "dies" mid-insert), a restart, an fsck that
+quarantines the damage, and a deploy that falls back to the latest
+intact COMPLETED instance instead of dying on an unpickling traceback.
+"""
+
+import sqlite3
+import time
+from datetime import timedelta
+
+import pytest
+
+import sample_engine as se
+from predictionio_tpu.core import (
+    CoreWorkflow, Engine, EngineParams, RuntimeContext,
+)
+from predictionio_tpu.data import fsck, integrity
+from predictionio_tpu.data.event import Event, utcnow
+from predictionio_tpu.data.storage import StorageRegistry, set_default
+from predictionio_tpu.data.storage.base import (
+    EngineInstance, EngineInstanceStatus, Model,
+)
+from predictionio_tpu.obs import MetricsRegistry, get_registry
+from predictionio_tpu.resilience import FaultError, RetryBudget, faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with the chaos harness disarmed."""
+    faults().clear()
+    yield
+    faults().clear()
+
+
+# -- envelope ----------------------------------------------------------------
+
+class TestEnvelope:
+    def test_round_trip(self):
+        payload = b"\x00\x01model bytes\xff"
+        blob = integrity.wrap(payload)
+        assert integrity.is_enveloped(blob)
+        assert integrity.unwrap(blob) == payload
+        assert integrity.verify(blob) == (True, "ok")
+
+    def test_crc32_algo_round_trip(self):
+        blob = integrity.wrap(b"abc", algo=integrity.ALGO_CRC32)
+        assert integrity.unwrap(blob) == b"abc"
+
+    def test_legacy_blob_passes_through(self):
+        legacy = b"not-enveloped pickle bytes"
+        assert not integrity.is_enveloped(legacy)
+        assert integrity.unwrap(legacy) == legacy
+        assert integrity.verify(legacy) == (True, "legacy")
+
+    def test_bit_flip_detected(self):
+        blob = bytearray(integrity.wrap(b"payload"))
+        blob[-1] ^= 0x01
+        with pytest.raises(integrity.CorruptBlobError, match="digest"):
+            integrity.unwrap(bytes(blob))
+
+    def test_truncation_detected(self):
+        blob = integrity.wrap(b"payload")
+        with pytest.raises(integrity.CorruptBlobError, match="length"):
+            integrity.unwrap(blob[:-3])
+        ok, reason = integrity.verify(blob[:-3])
+        assert not ok and "length" in reason
+
+    def test_unknown_version_and_algo_rejected(self):
+        blob = bytearray(integrity.wrap(b"x"))
+        blob[4] = 9               # format version byte
+        with pytest.raises(integrity.CorruptBlobError, match="version"):
+            integrity.unwrap(bytes(blob))
+        blob = bytearray(integrity.wrap(b"x"))
+        blob[5] = 7               # digest algo byte
+        with pytest.raises(integrity.CorruptBlobError, match="algo"):
+            integrity.unwrap(bytes(blob))
+
+
+class TestAtomicWrite:
+    def test_write_then_no_tmp_left(self, tmp_path):
+        target = tmp_path / "blob.bin"
+        integrity.atomic_write_bytes(target, b"hello")
+        assert target.read_bytes() == b"hello"
+        assert list(tmp_path.glob("*.tmp")) == []
+
+    def test_overwrite_is_all_or_nothing(self, tmp_path):
+        target = tmp_path / "blob.bin"
+        integrity.atomic_write_bytes(target, b"old")
+        integrity.atomic_write_bytes(target, b"new content")
+        assert target.read_bytes() == b"new content"
+
+    def test_purge_tmp_siblings(self, tmp_path):
+        target = tmp_path / "pio_model_x"
+        (tmp_path / "pio_model_x.123.abcd.tmp").write_bytes(b"torn")
+        (tmp_path / "pio_model_y.tmp.unrelated").write_bytes(b"keep")
+        assert integrity.purge_tmp_siblings(target) == 1
+        assert (tmp_path / "pio_model_y.tmp.unrelated").exists()
+
+    def test_quarantine_file_moves_and_writes_reason(self, tmp_path):
+        bad = tmp_path / "pio_model_bad"
+        bad.write_bytes(b"garbage")
+        dest = integrity.quarantine_file(bad, "digest mismatch")
+        assert not bad.exists()
+        assert dest.parent.name == ".quarantine"
+        reason = dest.with_name(dest.name + ".reason").read_text()
+        assert "digest mismatch" in reason
+
+
+# -- drivers -----------------------------------------------------------------
+
+def _localfs_registry(tmp_path, **extra):
+    cfg = {"PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+           "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+           "PIO_STORAGE_SOURCES_FS_TYPE": "LOCALFS",
+           "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+           "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS"}
+    cfg.update(extra)
+    return StorageRegistry(cfg)
+
+
+class TestLocalFSDurability:
+    def test_blob_enveloped_on_disk(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        reg.get_model_data_models().insert(Model("m1", b"payload"))
+        raw = (tmp_path / "models" / "pio_model_m1").read_bytes()
+        assert raw.startswith(integrity.BLOB_MAGIC)
+        assert reg.get_model_data_models().get("m1").models == b"payload"
+
+    def test_corrupt_blob_raises_typed_error(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        reg.get_model_data_models().insert(Model("m1", b"payload"))
+        f = tmp_path / "models" / "pio_model_m1"
+        raw = bytearray(f.read_bytes())
+        raw[-1] ^= 0xFF
+        f.write_bytes(bytes(raw))
+        with pytest.raises(integrity.CorruptBlobError):
+            reg.get_model_data_models().get("m1")
+
+    def test_legacy_unenveloped_blob_still_readable(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        (tmp_path / "models").mkdir(exist_ok=True)
+        (tmp_path / "models" / "pio_model_old").write_bytes(b"legacy")
+        assert reg.get_model_data_models().get("old").models == b"legacy"
+
+    def test_delete_purges_tmp_siblings(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        orphan = tmp_path / "models" / "pio_model_m1.99.beef.tmp"
+        orphan.write_bytes(b"torn tmp")
+        models.delete("m1")
+        assert not orphan.exists()
+        assert models.get("m1") is None
+
+    def test_fsck_reports_then_repairs(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("ok", b"fine"))
+        bad = tmp_path / "models" / "pio_model_bad"
+        bad.write_bytes(integrity.wrap(b"x" * 64)[:-5])
+        (tmp_path / "models" / "pio_model_bad.1.a.tmp").write_bytes(b"t")
+        report = models.fsck(repair=False)
+        kinds = sorted(f["kind"] for f in report)
+        assert kinds == ["corrupt_blob", "tmp_orphan"]
+        assert all(f["action"] == "none" for f in report)
+        assert bad.exists()                    # report-only did not act
+        repaired = models.fsck(repair=True)
+        assert {f["kind"] for f in repaired} == {"corrupt_blob",
+                                                 "tmp_orphan"}
+        assert not bad.exists()
+        qdir = tmp_path / "models" / ".quarantine"
+        assert (qdir / "pio_model_bad").exists()
+        assert models.fsck(repair=False) == []  # clean after repair
+        assert models.get("ok").models == b"fine"
+
+
+class TestSQLiteDurability:
+    def _registry(self, tmp_path):
+        return StorageRegistry({
+            "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB"})
+
+    def test_corrupt_row_quarantined_to_table(self, tmp_path):
+        reg = self._registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        conn = sqlite3.connect(tmp_path / "pio.db")
+        with conn:
+            conn.execute("UPDATE models SET models=? WHERE id=?",
+                         (integrity.wrap(b"payload")[:-2], "m1"))
+        conn.close()
+        with pytest.raises(integrity.CorruptBlobError):
+            models.get("m1")
+        report = models.fsck(repair=True)
+        assert report and report[0]["kind"] == "corrupt_blob"
+        assert models.get("m1") is None
+        conn = sqlite3.connect(tmp_path / "pio.db")
+        rows = conn.execute(
+            "SELECT id, reason FROM models_quarantine").fetchall()
+        conn.close()
+        assert rows[0][0] == "m1" and "length" in rows[0][1]
+
+    def test_heartbeat_column_round_trips(self, tmp_path):
+        reg = self._registry(tmp_path)
+        instances = reg.get_meta_data_engine_instances()
+        ts = utcnow()
+        iid = instances.insert(_training_row(start=ts))
+        assert instances.get(iid).heartbeat is None
+        instances.record_heartbeat(iid)
+        beat = instances.get(iid).heartbeat
+        assert beat is not None and abs(
+            (beat - ts).total_seconds()) < 60
+
+
+# -- journals ----------------------------------------------------------------
+
+class TestEventLogTornTail:
+    def _registry(self, tmp_path, kind):
+        return StorageRegistry({
+            "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_SOURCES_EV_TYPE": kind,
+            "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB"})
+
+    def _event(self, i):
+        return Event(event="buy", entity_type="user", entity_id=f"u{i}")
+
+    def test_evlog_torn_append_truncated_by_fsck(self, tmp_path):
+        reg = self._registry(tmp_path, "EVLOG")
+        events = reg.get_events()
+        events.init(1)
+        events.insert(self._event(1), 1)
+        faults().arm("evlog.append.partial", torn=0.4)
+        with pytest.raises(FaultError):
+            events.insert(self._event(2), 1)
+        faults().clear()
+        report = events.fsck(repair=False)
+        torn = [f for f in report if f["kind"] == "torn_tail"]
+        assert torn and torn[0]["action"] == "none"
+        repaired = events.fsck(repair=True)
+        assert any("truncated" in f["action"] for f in repaired)
+        assert events.fsck(repair=False) == []
+        # the journal accepts appends again and the good prefix survived
+        events.insert(self._event(3), 1)
+        found = sorted(e.entity_id for e in events.find(1))
+        assert found == ["u1", "u3"]
+
+    def test_pevlog_torn_tail_and_stale_index(self, tmp_path):
+        reg = self._registry(tmp_path, "PEVLOG")
+        events = reg.get_events()
+        events.init(1)
+        for i in range(3):
+            events.insert(self._event(i), 1)
+        assert events.fsck(repair=False) == []   # healthy store is clean
+        # crash between journal append and index flush: sidecar missing
+        idx = next((tmp_path / "ev").rglob("*.idx"))
+        idx.unlink()
+        report = events.fsck(repair=False)
+        stale = [f for f in report if f["kind"] == "stale_index"]
+        assert stale and stale[0]["action"] == "none"
+        repaired = events.fsck(repair=True)
+        assert any(f["action"] == "rebuilt" for f in repaired)
+        assert idx.exists()
+        assert events.fsck(repair=False) == []
+        assert len(list(events.find(1))) == 3
+        # torn tail on a segment journal: garbage past the last frame
+        seg = next((tmp_path / "ev").rglob("*.log"))
+        with open(seg, "ab") as fh:
+            fh.write(b"\x00garbage-torn-frame")
+        report = events.fsck(repair=True)
+        assert any(f["kind"] == "torn_tail" for f in report)
+        assert len(list(events.find(1))) == 3
+
+
+# -- janitor + heartbeat -----------------------------------------------------
+
+def _training_row(start=None, status=EngineInstanceStatus.TRAINING,
+                  heartbeat=None):
+    t = start or utcnow()
+    return EngineInstance(
+        id="", status=status, start_time=t, end_time=t,
+        engine_id="default", engine_version="default",
+        engine_variant="default", engine_factory="f",
+        heartbeat=heartbeat)
+
+
+class TestJanitor:
+    def test_stale_training_row_marked_failed(self, mem_registry):
+        instances = mem_registry.get_meta_data_engine_instances()
+        old = utcnow() - timedelta(hours=2)
+        stale_id = instances.insert(_training_row(start=old))
+        fresh_id = instances.insert(_training_row())
+        done = _training_row(start=old,
+                             status=EngineInstanceStatus.COMPLETED)
+        done_id = instances.insert(done)
+        findings = fsck.janitor_stale_instances(
+            mem_registry, stale_after_s=600, repair=True)
+        assert [f["id"] for f in findings] == [stale_id]
+        assert "marked FAILED" in findings[0]["action"]
+        assert instances.get(stale_id).status == EngineInstanceStatus.FAILED
+        assert instances.get(fresh_id).status == EngineInstanceStatus.TRAINING
+        assert instances.get(done_id).status == EngineInstanceStatus.COMPLETED
+
+    def test_recent_heartbeat_keeps_old_row_alive(self, mem_registry):
+        instances = mem_registry.get_meta_data_engine_instances()
+        old = utcnow() - timedelta(hours=2)
+        iid = instances.insert(_training_row(start=old))
+        instances.record_heartbeat(iid)     # trainer is alive, just slow
+        findings = fsck.janitor_stale_instances(
+            mem_registry, stale_after_s=600, repair=True)
+        assert findings == []
+        assert instances.get(iid).status == EngineInstanceStatus.TRAINING
+
+    def test_report_only_leaves_row_untouched(self, mem_registry):
+        instances = mem_registry.get_meta_data_engine_instances()
+        old = utcnow() - timedelta(hours=2)
+        iid = instances.insert(_training_row(start=old))
+        findings = fsck.janitor_stale_instances(
+            mem_registry, stale_after_s=600, repair=False)
+        assert findings and findings[0]["action"] == "none"
+        assert instances.get(iid).status == EngineInstanceStatus.TRAINING
+
+
+def _sample_engine():
+    return Engine(
+        data_source={"": se.SDataSource},
+        preparator=se.SPreparator,
+        algorithms={"algo": se.SAlgo},
+        serving={"": se.SServing},
+    )
+
+
+def _sample_params():
+    return EngineParams(
+        data_source_params=("", se.SDataSourceParams(id=7)),
+        preparator_params=("", se.SPreparatorParams(id=8)),
+        algorithm_params_list=(("algo", se.SAlgoParams(id=9)),),
+        serving_params=("", se.SServingParams()),
+    )
+
+
+class TestTrainHeartbeat:
+    def test_run_train_records_heartbeat(self, tmp_path):
+        reg = _localfs_registry(tmp_path,
+                                PIO_TRAIN_HEARTBEAT_S="0.01")
+        row = CoreWorkflow.run_train(
+            _sample_engine(), _sample_params(),
+            RuntimeContext(registry=reg))
+        assert row.status == EngineInstanceStatus.COMPLETED
+        stored = reg.get_meta_data_engine_instances().get(row.id)
+        assert stored.heartbeat is not None
+
+    def test_beat_thread_updates_row(self, mem_registry):
+        from predictionio_tpu.core import workflow
+        import threading
+        instances = mem_registry.get_meta_data_engine_instances()
+        iid = instances.insert(_training_row())
+        stop = threading.Event()
+        thread = workflow._start_heartbeat(instances, iid, stop,
+                                           interval_s=0.01)
+        deadline = time.monotonic() + 2.0
+        while instances.get(iid).heartbeat is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        workflow._stop_heartbeat(stop, thread)
+        assert instances.get(iid).heartbeat is not None
+        assert not thread.is_alive()
+
+
+# -- retry budget ------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_bucket_spend_and_refill(self):
+        budget = RetryBudget(capacity=2, refill_per_s=200.0)
+        assert budget.try_acquire()
+        assert budget.try_acquire()
+        assert not budget.try_acquire()     # dry
+        time.sleep(0.02)                    # ~4 tokens refilled, capped at 2
+        assert budget.try_acquire()
+
+    def test_zero_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            RetryBudget(capacity=0)
+
+    def test_budget_exhaustion_abandons_retries(self):
+        reg = StorageRegistry({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_RETRY_ATTEMPTS": "4",
+            "PIO_STORAGE_SOURCES_MEM_RETRY_BASE_DELAY": "0.001",
+            "PIO_STORAGE_SOURCES_MEM_RETRY_BUDGET": "1",
+            "PIO_STORAGE_SOURCES_MEM_BREAKER_THRESHOLD": "100",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM"})
+        events = reg.get_events()
+        events.init(1)
+        rule = faults().arm("storage.MEM.Events.insert", error=OSError)
+        before = get_registry().value(
+            "pio_retry_budget_exhausted_total", source="MEM")
+        with pytest.raises(OSError):
+            events.insert(Event(event="buy", entity_type="user",
+                                entity_id="u1"), 1)
+        after = get_registry().value(
+            "pio_retry_budget_exhausted_total", source="MEM")
+        # attempt 1 + the single budgeted retry; retry 2 found the
+        # bucket dry and surfaced the original error early
+        assert rule.hits == 2
+        assert after == before + 1
+
+    def test_budget_off_knob_disables(self):
+        reg = StorageRegistry({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_RETRY_BUDGET": "off",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM"})
+        dao = reg.get_events()
+        assert dao._budget is None
+
+
+# -- the chaos scenario ------------------------------------------------------
+
+class TestTornWriteRecovery:
+    """Acceptance scenario: torn model write -> restart -> fsck
+    quarantine -> deploy falls back to the latest intact COMPLETED."""
+
+    def test_torn_write_restart_fsck_deploy(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        engine, params = _sample_engine(), _sample_params()
+        good = CoreWorkflow.run_train(engine, params,
+                                      RuntimeContext(registry=reg))
+        assert good.status == EngineInstanceStatus.COMPLETED
+        # train #2: the process "crashes" mid model write
+        faults().arm("storage.FS.models.insert.torn", torn=0.5)
+        with pytest.raises(FaultError):
+            CoreWorkflow.run_train(engine, params,
+                                   RuntimeContext(registry=reg))
+        faults().clear()
+        instances = reg.get_meta_data_engine_instances()
+        failed = [r for r in instances.get_all()
+                  if r.status == EngineInstanceStatus.FAILED]
+        assert len(failed) == 1
+        torn_file = tmp_path / "models" / f"pio_model_{failed[0].id}"
+        assert torn_file.exists()           # the torn bytes landed
+
+        # ---- "restart": a fresh registry over the same paths ----------
+        reg2 = _localfs_registry(tmp_path)
+        q_before = get_registry().value("pio_fsck_quarantined_total")
+        report = fsck.doctor(reg2, repair=False)
+        assert report["unrepaired"] >= 1    # report-only: rc-1 shape
+        report = fsck.doctor(reg2, repair=True)
+        assert report["unrepaired"] == 0
+        kinds = {f["kind"] for f in report["fsck"]}
+        assert "corrupt_blob" in kinds
+        q_after = get_registry().value("pio_fsck_quarantined_total")
+        assert q_after > q_before
+        assert not torn_file.exists()
+        qdir = tmp_path / "models" / ".quarantine"
+        assert (qdir / torn_file.name).exists()
+
+        # deploy resolves the latest COMPLETED instance and serves it
+        latest = reg2.get_meta_data_engine_instances() \
+            .get_latest_completed("default", "default", "default")
+        assert latest is not None and latest.id == good.id
+        algos, models, _serving = CoreWorkflow.prepare_deploy(
+            engine, latest, RuntimeContext(registry=reg2),
+            engine_params=params)
+        assert algos and models
+
+    def test_startup_check_reports_but_does_not_quarantine(self, tmp_path):
+        reg = _localfs_registry(tmp_path)
+        (tmp_path / "models").mkdir(exist_ok=True)
+        bad = tmp_path / "models" / "pio_model_bad"
+        bad.write_bytes(integrity.wrap(b"y" * 32)[:-3])
+        report = fsck.startup_check(reg)
+        assert report is not None
+        assert any(f["kind"] == "corrupt_blob" for f in report["fsck"])
+        assert bad.exists()                 # startup is report-only
+        off = _localfs_registry(tmp_path, PIO_FSCK_ON_STARTUP="off")
+        assert fsck.startup_check(off) is None
+
+
+# -- doctor CLI --------------------------------------------------------------
+
+class TestDoctorCLI:
+    def test_rc_semantics(self, tmp_path, capsys):
+        from predictionio_tpu.cli.main import main
+        reg = _localfs_registry(tmp_path)
+        set_default(reg)
+        try:
+            assert main(["doctor"]) == 0            # clean store
+            bad = tmp_path / "models" / "pio_model_bad"
+            bad.write_bytes(integrity.wrap(b"z" * 16)[:-1])
+            assert main(["doctor"]) == 1            # damage, report-only
+            assert bad.exists()
+            assert main(["doctor", "--repair"]) == 0
+            assert not bad.exists()
+            assert main(["doctor"]) == 0            # clean again
+            out = capsys.readouterr().out
+            assert '"unrepaired"' in out
+        finally:
+            set_default(None)
+
+    def test_stale_after_flag_reaches_janitor(self, tmp_path, capsys):
+        from predictionio_tpu.cli.main import main
+        reg = _localfs_registry(tmp_path)
+        instances = reg.get_meta_data_engine_instances()
+        old = utcnow() - timedelta(seconds=30)
+        iid = instances.insert(_training_row(start=old))
+        set_default(reg)
+        try:
+            # 1h threshold: the 30s-old row is fine
+            assert main(["doctor", "--stale-after", "3600"]) == 0
+            # 1s threshold + repair: janitored to FAILED
+            assert main(["doctor", "--repair",
+                         "--stale-after", "1"]) == 0
+            assert instances.get(iid).status == EngineInstanceStatus.FAILED
+        finally:
+            set_default(None)
+        capsys.readouterr()
+
+
+# -- dashboard ---------------------------------------------------------------
+
+class TestDashboardDurabilityPanel:
+    def test_panel_lists_durability_families(self):
+        from predictionio_tpu.tools.dashboard import _metrics_page
+        metrics = MetricsRegistry()
+        page = _metrics_page(metrics)
+        assert "Durability &amp; resilience" in page
+        assert "No breaker/fsck/janitor/retry-budget activity" in page
+        metrics.counter("pio_fsck_quarantined_total", "q").inc()
+        metrics.counter("pio_janitor_failed_total", "j").inc(2)
+        metrics.counter("pio_unrelated_total", "u").inc()
+        page = _metrics_page(metrics)
+        panel = page.split("All families")[0]
+        assert "pio_fsck_quarantined_total" in panel
+        assert "pio_janitor_failed_total" in panel
+        assert "pio_unrelated_total" not in panel
+        assert "pio_unrelated_total" in page     # still in the full dump

@@ -119,3 +119,42 @@ def test_bounded_wait_gate_scoped_to_resilient_layers(tmp_path):
         "    done.wait()\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_storage_write_gate_catches_direct_writes(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "data" / "storage" / "torn.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "def save(path, blob, note):\n"
+        "    path.write_bytes(blob)\n"
+        "    path.write_text(note)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "direct .write_bytes()" in kinds
+    assert "direct .write_text()" in kinds
+    assert "atomic_write_bytes" in kinds
+
+
+def test_storage_write_gate_allows_tmp_and_escape(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "data" / "storage" / "ok.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def save(path, blob):\n"
+        "    path.with_suffix('.tmp').write_bytes(blob)\n"
+        "    path.write_bytes(blob)  # lint: ok\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_storage_write_gate_scoped_to_storage_drivers(tmp_path):
+    # data/ outside storage/ is not under the atomic-write mandate
+    ok = tmp_path / "predictionio_tpu" / "data" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def save(path, blob):\n"
+        "    path.write_bytes(blob)\n"
+    )
+    assert not lint.run(tmp_path)
