@@ -1381,9 +1381,14 @@ def bench_ecommerce_scale(n_users: int = 5_000, n_items: int = 50_000,
                 # lambda_=0.1: at rank 32 over zipf-skewed implicit
                 # confidences the default reg leaves the warm-CG system
                 # ill-conditioned (the solver's residual warning fires)
+                # cg_iters=32: alpha=20 makes the implicit normal
+                # equations stiff at this scale; the solver default (8
+                # sweeps) leaves a ~2.6e-1 residual and fires the
+                # convergence warning
                 ("ecomm", ec.ECommParams(app_name="ecbench50k", rank=32,
                                          num_iterations=5, alpha=20.0,
-                                         lambda_=0.1, seed=1)),))
+                                         lambda_=0.1, seed=1,
+                                         cg_iters=32)),))
         ctx = RuntimeContext(registry=reg)
         t0 = time.perf_counter()
         CoreWorkflow.run_train(engine, params, ctx)
@@ -1446,13 +1451,22 @@ def bench_ecommerce_scale(n_users: int = 5_000, n_items: int = 50_000,
                 if {s["item"] for s in res["itemScores"]} & banned:
                     raise SystemExit("unavailable item served")
 
-            _fanout(req, 32, 8)    # warm: compile batch buckets
-            dt = _fanout(req, 32, 8)
+            from predictionio_tpu.obs import compile_watch
+            _fanout(req, 32, 8)    # warm: first drains settle the policy
+            with compile_watch() as watch:
+                dt = _fanout(req, 32, 8)
             qps = 32 * 8 / dt
             dev_b = topk.DISPATCH_COUNTS["device"] - before["device"]
             host_b = topk.DISPATCH_COUNTS["host"] - before["host"]
-            print(f"# ecommerce_scale dispatch: {dev_b} device batches, "
-                  f"{host_b} host calls", file=sys.stderr)
+            # dispatch mix + steady-state recompiles as gateable metrics
+            # (was a stderr comment): r05 measured 0 device / 552 host;
+            # the AOT bucket plan must invert that, at 0 recompiles
+            emit(f"ecommerce_{n_items//1000}k_serve_device_batches",
+                 dev_b, "batches", dev_b / max(1.0, float(host_b)))
+            emit(f"ecommerce_{n_items//1000}k_serve_host_calls",
+                 host_b, "calls", 1.0)
+            emit(f"ecommerce_{n_items//1000}k_steady_state_recompiles",
+                 watch.count, "compiles", 1.0)
             # baseline QPS: one query per sequential host-scorer pass
             emit(f"ecommerce_{n_items//1000}k_serve_qps_microbatch",
                  qps, "qps", qps * base_p50 / 1e3)

@@ -123,6 +123,15 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         jit'd program over all queries."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    def warm_serving(self, model: M, buckets: Sequence[int]) -> int:
+        """Deploy-time warmup hook: pin model state device-resident and
+        AOT-compile the serve executables for the given batch-size
+        `buckets`, so the first real request (and every one after) hits a
+        precompiled static shape. Returns the number of executables
+        compiled; the default is a no-op for host-only algorithms. Called
+        by `CoreWorkflow.prepare_deploy` after models are loaded."""
+        return 0
+
 
 class Serving(_Component, Generic[Q, P]):
     """Query supplement + multi-algorithm result combination

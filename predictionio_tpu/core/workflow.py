@@ -205,11 +205,16 @@ class CoreWorkflow:
     @staticmethod
     def prepare_deploy(engine: Engine, instance: EngineInstance,
                        ctx: RuntimeContext,
-                       engine_params: Optional[EngineParams] = None
+                       engine_params: Optional[EngineParams] = None,
+                       *, warm_batch_max: Optional[int] = None
                        ) -> Tuple[List[Any], List[Any], Any]:
         """Load (or retrain) the instance's models for serving; returns
         (algorithms, models, serving). (Engine.prepareDeploy +
-        CreateServer.createServerActorWithEngine:186-244)."""
+        CreateServer.createServerActorWithEngine:186-244).
+
+        `warm_batch_max` caps the batch buckets AOT-warmed through each
+        algorithm's `warm_serving` hook (the server passes its
+        micro-batcher `batch_max`); None skips warmup entirely."""
         if engine_params is None:
             engine_params = engine_params_from_instance(engine, instance)
         from predictionio_tpu.core.engine import bind_serving_context
@@ -230,7 +235,55 @@ class CoreWorkflow:
 
         models = deserialize_models(blob_row.models, instance.id, algos,
                                     ctx, retrain)
+        if warm_batch_max is not None:
+            warm_deploy(algos, models, warm_batch_max)
         return algos, models, serving
+
+
+def warm_deploy(algos: List[Any], models: List[Any],
+                warm_batch_max: int) -> int:
+    """AOT-warm every algorithm's serve executables for the power-of-two
+    batch buckets up to `warm_batch_max`, pinning model state device
+    resident, so steady-state serving never recompiles. Warmup cost/count
+    land in the default metrics registry (`pio_serve_warmup_seconds`,
+    `pio_serve_warmup_compiles_total`); `PIO_SERVE_WARMUP=off` disables.
+    A warmup failure is logged, never fatal — the generic dispatch paths
+    still serve correctly, just slower on first touch."""
+    import os
+    import time as _time
+    if os.environ.get("PIO_SERVE_WARMUP", "on").lower() in (
+            "off", "0", "false"):
+        return 0
+    # compiles during warmup must be attributed (and post-warmup drift
+    # detectable), so the probe goes in before the first lowering
+    install_compile_probe()
+    buckets: List[int] = []
+    b = 1
+    while b <= max(1, int(warm_batch_max)):
+        buckets.append(b)
+        b *= 2
+    from predictionio_tpu.obs import get_registry
+    reg = get_registry()
+    t0 = _time.perf_counter()
+    compiled = 0
+    for algo, model in zip(algos, models):
+        label = type(algo).__name__
+        try:
+            n = algo.warm_serving(model, buckets)
+            compiled += int(n or 0)
+        except Exception as e:
+            _log.warning("serve_warmup_failed", algo=label,
+                         error=f"{type(e).__name__}: {e}")
+    reg.gauge("pio_serve_warmup_seconds",
+              "Wall time of the last deploy serve warmup").set(
+        _time.perf_counter() - t0)
+    if compiled:
+        reg.counter(
+            "pio_serve_warmup_compiles_total",
+            "Serve executables AOT-compiled at deploy warmup").inc(compiled)
+    _log.info("serve_warmup", buckets=buckets, compiled=compiled,
+              seconds=round(_time.perf_counter() - t0, 3))
+    return compiled
 
 
 def engine_params_from_instance(engine: Engine,

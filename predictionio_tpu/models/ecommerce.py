@@ -31,7 +31,10 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import BiMap, RatingColumns
 from predictionio_tpu.ops import als
-from predictionio_tpu.ops.topk import NEG_INF, topk_scores, topk_similar
+from predictionio_tpu.ops.topk import (
+    NEG_INF, BucketedTopK, _next_pow2, topk_scores, topk_scores_filtered,
+    topk_similar,
+)
 
 
 @dataclass(frozen=True)
@@ -120,6 +123,10 @@ class ECommParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: Optional[int] = None
+    # None = solver default; raise for large implicit problems where the
+    # normal-equation CG needs more sweeps to converge (high alpha makes
+    # the preference system stiff)
+    cg_iters: Optional[int] = None
 
 
 class ECommAlgorithm(Algorithm):
@@ -134,10 +141,12 @@ class ECommAlgorithm(Algorithm):
         if pd.views.n == 0:
             raise ValueError("No view events found "
                              "(ECommAlgorithm.train require non-empty)")
+        extra = {} if p.cg_iters is None else {"cg_iters": p.cg_iters}
         x, y = als.als_train(
             pd.views, rank=p.rank, iterations=p.num_iterations,
             reg=p.lambda_, implicit=True, alpha=p.alpha,
-            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh,
+            **extra)
         pop = np.zeros(len(pd.views.items), np.float32)
         np.add.at(pop, pd.buys.item_ix, 1.0)
         return ECommModel(x, y, pd.views.users, pd.views.items, pop,
@@ -236,12 +245,72 @@ class ECommAlgorithm(Algorithm):
                  for s, ix in zip(scores, ixs) if s > NEG_INF / 2]
         return PredictedResult(tuple(items))
 
+    def warm_serving(self, model: ECommModel, buckets) -> int:
+        """Build the deploy-time serving plan: item factors pinned device
+        resident, one AOT executable per batch bucket, banned width sized
+        to the CURRENT unavailableItems constraint plus headroom for
+        per-user seen/blackList indices."""
+        ctx = getattr(self, "_serving_ctx", None)
+        n_unavail = len(self._unavailable_items(ctx)) if ctx else 0
+        width = _next_pow2(max(256, n_unavail + 128))
+        self._serve_plan = BucketedTopK(
+            model.item_factors, k=Query().num, buckets=buckets,
+            banned_width=width)
+        return self._serve_plan.warm()
+
     def batch_predict(self, model, queries):
+        """Batched serve path. Known-user queries without dense-mask
+        needs (no categories/whiteList) coalesce into ONE banned-index
+        top-k dispatch — through the deploy-warmed `BucketedTopK` plan
+        (device-resident factors, bucket-padded static shape, zero
+        recompiles) when the batch fits it, else the generic
+        `topk_scores_filtered`. Everything else (unknown users, dense
+        filters) falls back to the per-query three-way predict."""
         # the unavailableItems constraint read is shared across the batch
         ctx = self._ctx()
         unavailable = self._unavailable_items(ctx)
-        return [(i, self._predict_one(ctx, model, q, unavailable))
-                for i, q in queries]
+        unavail_ix = [ix for it in unavailable
+                      if (ix := model.items.get(it)) is not None]
+        n_items = model.item_factors.shape[0]
+        batched = []    # (orig_i, query, user_ix, banned indices)
+        out = []
+        for i, q in queries:
+            u_ix = model.users.get(q.user)
+            if (q.categories is None and q.whiteList is None
+                    and u_ix is not None
+                    and np.any(model.user_factors[u_ix])):
+                banned = list(unavail_ix)
+                banned += [ix for it in self._seen_items(ctx, q.user)
+                           if (ix := model.items.get(it)) is not None]
+                banned += [ix for it in (q.blackList or ())
+                           if (ix := model.items.get(it)) is not None]
+                batched.append((i, q, u_ix, banned))
+            else:
+                out.append((i, self._predict_one(ctx, model, q,
+                                                 unavailable)))
+        if not batched:
+            return out
+        vecs = model.user_factors[
+            np.array([u for _, _, u, _ in batched])].astype(np.float32)
+        banned_lists = [b for _, _, _, b in batched]
+        k = max(min(q.num, n_items) for _, q, _, _ in batched)
+        plan = getattr(self, "_serve_plan", None)
+        if plan is not None and plan.fits(
+                max_banned=max(map(len, banned_lists)), k=k):
+            scores, ixs = plan(vecs, banned_lists)
+        else:
+            scores, ixs = topk_scores_filtered(
+                vecs, model.item_factors, banned_lists, k=k)
+        scores, ixs = np.asarray(scores), np.asarray(ixs)
+        for row, (i, q, _, _) in enumerate(batched):
+            items = []
+            for s, ix in zip(scores[row], ixs[row]):
+                if s <= NEG_INF / 2 or len(items) >= q.num:
+                    continue
+                items.append(ItemScore(model.items.inverse(int(ix)),
+                                       float(s)))
+            out.append((i, PredictedResult(tuple(items))))
+        return out
 
     def with_serving_context(self, ctx: RuntimeContext) -> "ECommAlgorithm":
         self._serving_ctx = ctx

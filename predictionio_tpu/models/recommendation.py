@@ -30,7 +30,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import RatingColumns
 from predictionio_tpu.ops import als
-from predictionio_tpu.ops.topk import (NEG_INF, topk_scores,
+from predictionio_tpu.ops.topk import (NEG_INF, BucketedTopK, topk_scores,
                                        topk_scores_filtered)
 
 
@@ -158,6 +158,15 @@ class ALSAlgorithm(Algorithm):
     def predict(self, model: als.ALSModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
+    def warm_serving(self, model: als.ALSModel, buckets) -> int:
+        """Deploy warmup: pin item factors device-resident and AOT-compile
+        the per-bucket banned-index executables (blackList queries are the
+        common case; whiteList queries use the dense-mask path)."""
+        self._serve_plan = BucketedTopK(
+            model.item_factors, k=Query(user="").num, buckets=buckets,
+            banned_width=64)
+        return self._serve_plan.warm()
+
     def batch_predict(self, model: als.ALSModel,
                       queries: Sequence[Tuple[int, Query]]
                       ) -> List[Tuple[int, PredictedResult]]:
@@ -181,8 +190,13 @@ class ALSAlgorithm(Algorithm):
                 [ix for ix in (model.items.get(b) for b in (q.blackList or ()))
                  if ix is not None]
                 for _, q, _ in live]
-            scores, ixs = topk_scores_filtered(
-                vecs, model.item_factors, banned, k=k)
+            plan = getattr(self, "_serve_plan", None)
+            if plan is not None and plan.fits(
+                    max_banned=max(map(len, banned), default=0), k=k):
+                scores, ixs = plan(vecs, banned)
+            else:
+                scores, ixs = topk_scores_filtered(
+                    vecs, model.item_factors, banned, k=k)
         else:
             from predictionio_tpu.models.common import resolve_item_mask
             mask = np.concatenate(

@@ -16,6 +16,7 @@ process-default registry; `install_compile_probe` is idempotent.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Optional
 
 from predictionio_tpu.obs.metrics import MetricsRegistry, get_registry
@@ -63,3 +64,37 @@ def compile_count(registry: Optional[MetricsRegistry] = None) -> int:
     """Current backend-compile count (0 before the probe ever fired)."""
     counter, _ = _instruments(registry or get_registry())
     return int(counter.value)
+
+
+class _CompileWatch:
+    """Result object of `compile_watch`; `.count` is live inside the
+    block and frozen at exit."""
+
+    def __init__(self, registry: Optional[MetricsRegistry]):
+        self._registry = registry
+        self._before = compile_count(registry)
+        self._final: Optional[int] = None
+
+    @property
+    def count(self) -> int:
+        if self._final is not None:
+            return self._final
+        return compile_count(self._registry) - self._before
+
+
+@contextmanager
+def compile_watch(registry: Optional[MetricsRegistry] = None):
+    """Count backend compiles across a block::
+
+        with compile_watch() as w:
+            serve_a_lot()
+        assert w.count == 0   # steady state must not recompile
+
+    Installs the probe on entry (idempotent), so the first use in a
+    process is also correct."""
+    install_compile_probe(registry)
+    watch = _CompileWatch(registry)
+    try:
+        yield watch
+    finally:
+        watch._final = compile_count(registry) - watch._before

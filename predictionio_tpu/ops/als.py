@@ -72,6 +72,7 @@ import jax
 import numpy as np
 
 from predictionio_tpu.ingest import BiMap, RatingColumns
+from predictionio_tpu.ops import compat
 
 # degree-bucket caps grow geometrically; a row of degree d lands in the
 # smallest bucket with cap >= d. The x1.5 ladder (rounded up to a
@@ -612,10 +613,10 @@ def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
             return own_local, res
 
         def zero():
-            # per-device residual: mark varying over the mesh axis so the
-            # fori carry type is stable (see shard_map scan-vma docs)
-            return jax.lax.pcast(jnp.float32(0.0), ("data",),
-                                 to="varying")
+            # per-device residual: mark varying over the mesh axis so
+            # the fori carry type is stable (see shard_map scan-vma
+            # docs)
+            return compat.pcast_varying(jnp.float32(0.0), "data")
 
         def it(_, state):
             # final-iteration residual only (see _run_als note)
@@ -632,7 +633,7 @@ def _run_als_sharded(x_sh, y_sh, user_slabs, item_slabs, reg, alpha,
                           for a in slab) for slab in user_slabs]
     slab_specs_i = [tuple(P("data", *([None] * (a.ndim - 1)))
                           for a in slab) for slab in item_slabs]
-    fsharded = jax.shard_map(
+    fsharded = compat.shard_map(
         body, mesh=mesh,
         in_specs=(P("data", None), P("data", None),
                   slab_specs_u, slab_specs_i),

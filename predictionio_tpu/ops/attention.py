@@ -35,6 +35,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from predictionio_tpu.ops import compat
+
 _NEG = -1e30
 
 
@@ -159,6 +161,6 @@ def ring_attention(q, k, v, mesh, *, axis: str = "sp",
         else None
     spec = P(b, axis, None, None)
     mspec = P(b, axis)
-    return jax.shard_map(body, mesh=mesh,
-                         in_specs=(spec, spec, spec, mspec),
-                         out_specs=spec)(q, k, v, kv_mask)
+    return compat.shard_map(body, mesh=mesh,
+                            in_specs=(spec, spec, spec, mspec),
+                            out_specs=spec)(q, k, v, kv_mask)

@@ -1,0 +1,35 @@
+"""Version shims for jax APIs the kernels rely on.
+
+The kernels target current jax (`jax.shard_map`, varying-mesh-axis
+tracking via `jax.lax.pcast`); this module lets them run unchanged on
+the pre-0.6 releases some deployment images pin, where shard_map still
+lives in `jax.experimental` and its replication checker predates
+`fori_loop`/`scan` carry support.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(body, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` where available, else the experimental one with
+    its (fori_loop/scan-incompatible) replication checker disabled —
+    the psum/ppermute collectives the kernels emit are identical under
+    both."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(body, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as fn
+    return fn(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
+def pcast_varying(x, axis: str):
+    """Mark `x` varying over `axis` for scan/fori carry-type stability;
+    a no-op on jax without vma tracking (there a replicated constant
+    carries fine)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return x

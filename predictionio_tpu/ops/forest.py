@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.ops import compat
+
 
 # rows sampled for quantile estimation: exact quantiles over millions
 # of rows cost ~10x more host time for bin edges that differ in the
@@ -243,7 +245,7 @@ def _grow_level(key, fb_cols, node, y, w, xb, *, n_nodes: int,
 
     body = partial(level,
                    hist_reduce=lambda h: jax.lax.psum(h, "data"))
-    return jax.shard_map(
+    return compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(), P("data", None), P(None, "data"), P("data"),
                   P(None, "data"), P("data", None)),
@@ -269,7 +271,7 @@ def _leaf_counts(node, y, w, *, n_nodes: int, n_classes: int, mesh=None):
     from jax.sharding import PartitionSpec as P
 
     body = partial(counts, reduce=lambda x: jax.lax.psum(x, "data"))
-    return jax.shard_map(
+    return compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(None, "data"), P("data"), P(None, "data")),
         out_specs=P())(node, y, w)

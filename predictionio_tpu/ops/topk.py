@@ -17,10 +17,28 @@ remote/tunneled device. Large batches (offline batchpredict, eval sweeps,
 big catalogs) go to the jit'd device kernel where the MXU matmul wins and
 the transfer amortizes. Inside a jit trace the device path is always used
 (host numpy cannot trace).
+
+Two dispatch refinements on top of the static size rule:
+
+  - `DispatchPolicy` — an amortized policy that keeps latency EWMAs per
+    path and can PROMOTE sub-crossover problems to the device once the
+    observed device round trip beats the predicted (GIL-contended) host
+    time. The static `HOST_CROSSOVER_CELLS` stays the upper bound: at or
+    above it the device always wins, exactly as before.
+  - `BucketedTopK` — the serving plan: per-bucket AOT-compiled
+    executables over a device-resident factor matrix, built at deploy
+    warmup. Calls go straight to the compiled executable (never the jit
+    tracing cache), so steady-state serving is zero-recompile by
+    construction.
+
+Every dispatch lands in `pio_topk_dispatch_total{path=host|device}` (the
+process-default metrics registry) and in `DISPATCH_COUNTS`.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 from typing import Optional, Sequence
 
@@ -48,6 +66,112 @@ HOST_CROSSOVER_CELLS = int(_os.environ.get(
 # plain ints under the GIL (worst case a lost increment, never a wrong
 # path).
 DISPATCH_COUNTS = {"host": 0, "device": 0}
+
+# Below this many score cells the amortized policy never promotes to the
+# device, whatever the EWMAs say: tiny unit-test-sized problems must stay
+# deterministically on the host path (and the promotion payoff only
+# exists for coalesced serve batches anyway).
+PROMOTE_FLOOR_CELLS = int(_os.environ.get(
+    "PIO_TOPK_PROMOTE_FLOOR_CELLS", 1 << 16))
+
+_DISPATCH_TOTAL = None
+
+
+def _dispatch_total():
+    """`pio_topk_dispatch_total{path=...}` in the process-default
+    registry (lazy: created on the first dispatch, like jaxprobe's
+    counters)."""
+    global _DISPATCH_TOTAL
+    if _DISPATCH_TOTAL is None:
+        from predictionio_tpu.obs import get_registry
+        _DISPATCH_TOTAL = get_registry().counter(
+            "pio_topk_dispatch_total",
+            "Top-k serve dispatches by path taken (host BLAS vs device "
+            "program; traced calls count as device)", labels=("path",))
+    return _DISPATCH_TOTAL
+
+
+class DispatchPolicy:
+    """Amortized host/device dispatch from observed per-path latency.
+
+    Cold start reproduces the legacy one-shot rule exactly: device iff
+    cells >= HOST_CROSSOVER_CELLS (read live, so tests and operators can
+    pin it). Once BOTH paths have been observed, problems between
+    PROMOTE_FLOOR_CELLS and the crossover are routed by predicted
+    latency:
+
+        host:   cells * host_s_per_cell_EWMA * (1 + in-flight host calls)
+        device: device_call_s_EWMA   (dispatch + readback dominated at
+                serve sizes; the matmul itself is microseconds)
+
+    The (1 + in-flight) factor is the batch-coalescing term: concurrent
+    host calls serialize on the GIL/BLAS while device dispatches overlap,
+    so the more the micro-batcher (or the concurrent per-algorithm loop)
+    piles onto the host path, the stronger the pull toward the device.
+    Promotion is one-directional — at or above the static crossover the
+    device always wins, as before — so a pinned
+    PIO_TOPK_HOST_CROSSOVER_CELLS keeps its meaning as an upper bound.
+    """
+
+    def __init__(self, alpha: float = 0.25):
+        self._alpha = alpha
+        self._lock = threading.Lock()
+        self._host_s_per_cell: Optional[float] = None
+        self._device_call_s: Optional[float] = None
+        self._host_inflight = 0
+
+    def choose(self, cells: int) -> str:
+        if cells >= HOST_CROSSOVER_CELLS:
+            return "device"
+        with self._lock:
+            h, d = self._host_s_per_cell, self._device_call_s
+            inflight = self._host_inflight
+        if h is None or d is None or cells < PROMOTE_FLOOR_CELLS:
+            return "host"
+        return "device" if d <= cells * h * (1.0 + inflight) else "host"
+
+    def host_begin(self) -> None:
+        with self._lock:
+            self._host_inflight += 1
+
+    def host_end(self) -> None:
+        with self._lock:
+            self._host_inflight = max(0, self._host_inflight - 1)
+
+    def observe(self, path: str, cells: int,
+                seconds: Optional[float]) -> None:
+        if seconds is None or cells <= 0:
+            return
+        a = self._alpha
+        with self._lock:
+            if path == "host":
+                per_cell = seconds / cells
+                prev = self._host_s_per_cell
+                self._host_s_per_cell = (per_cell if prev is None
+                                         else prev + a * (per_cell - prev))
+            else:
+                prev = self._device_call_s
+                self._device_call_s = (seconds if prev is None
+                                       else prev + a * (seconds - prev))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"host_s_per_cell": self._host_s_per_cell,
+                    "device_call_s": self._device_call_s,
+                    "host_inflight": self._host_inflight}
+
+
+DISPATCH_POLICY = DispatchPolicy()
+
+
+def _record_dispatch(path: str, cells: int,
+                     seconds: Optional[float] = None) -> None:
+    DISPATCH_COUNTS[path] += 1
+    try:
+        _dispatch_total().labels(path=path).inc()
+    except Exception:
+        pass  # metrics must never fail a serve call
+    DISPATCH_POLICY.observe(path, cells, seconds)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -125,9 +249,8 @@ def device_resident(arr):
     return dev
 
 
-@partial(jax.jit, static_argnames=("k", "has_bans"))
-def _topk_scores_banned_device(user_vecs, item_factors, banned, *,
-                               k: int, has_bans: bool):
+def _topk_scores_banned(user_vecs, item_factors, banned, *,
+                        k: int, has_bans: bool):
     scores = jnp.matmul(user_vecs, item_factors.T,
                         precision=jax.lax.Precision.HIGHEST)
     if has_bans:
@@ -135,6 +258,20 @@ def _topk_scores_banned_device(user_vecs, item_factors, banned, *,
         # out-of-range fill indices (== n_items) are dropped
         scores = scores.at[rows, banned].set(NEG_INF, mode="drop")
     return jax.lax.top_k(scores, k)
+
+
+_topk_scores_banned_device = partial(
+    jax.jit, static_argnames=("k", "has_bans"))(_topk_scores_banned)
+
+# The AOT serving-plan variant donates the per-call uploads (the padded
+# query block and its banned-index block) so XLA reuses their buffers
+# instead of allocating fresh ones every drain. The factor matrix (arg 1)
+# is the device-resident model state and is NOT donated. CPU backends
+# can't donate and would warn per compile, so the plan only picks this
+# variant off-CPU.
+_topk_scores_banned_donated = partial(
+    jax.jit, static_argnames=("k", "has_bans"),
+    donate_argnums=(0, 2))(_topk_scores_banned)
 
 
 def _next_pow2(n: int) -> int:
@@ -163,27 +300,29 @@ def topk_scores_filtered(user_vecs, item_factors, banned_lists, *, k: int):
     on_dev = _on_device(user_vecs, item_factors)
     max_banned = max((len(bl) for bl in banned_lists), default=0)
     wp = _next_pow2(max_banned) if max_banned else 0
-    if not traced and not on_dev and cells < HOST_CROSSOVER_CELLS:
+    if not traced and not on_dev \
+            and DISPATCH_POLICY.choose(cells) == "host":
         # small problems: densify the filter and delegate so the host
         # scoring/tie-breaking path exists in exactly one place
         mask = np.ones((b, n_items), bool)
         for row, banned in enumerate(banned_lists):
             if len(banned):
-                mask[row, np.asarray(banned, int)] = False
+                mask[row, np.asarray(banned, int)] = False  # lint: ok
         return topk_scores(user_vecs, item_factors, mask, k=k)
-    DISPATCH_COUNTS["device"] += 1
     banned_np = np.full((b, max(wp, 1)), n_items, np.int32)
     for row, bl in enumerate(banned_lists):
         if len(bl):
-            banned_np[row, :len(bl)] = np.asarray(bl, np.int32)
+            banned_np[row, :len(bl)] = np.asarray(bl, np.int32)  # lint: ok
     if traced or on_dev:
         # traced / already-on-device inputs: no host-side padding
         # round-trip; shapes are what the trace gives us
+        _record_dispatch("device", cells)
         out = _topk_scores_banned_device(
             user_vecs, item_factors, jnp.asarray(banned_np), k=k,
             has_bans=wp > 0)
         return out if traced else jax.device_get(out)
     # host inputs: pad batch to a power of two to bound jit variants
+    t0 = time.perf_counter()
     bp = _next_pow2(b)
     vecs = np.zeros((bp, user_vecs.shape[1]), np.float32)
     vecs[:b] = user_vecs
@@ -193,6 +332,7 @@ def topk_scores_filtered(user_vecs, item_factors, banned_lists, *, k: int):
         jnp.asarray(vecs), device_resident(item_factors),
         jnp.asarray(banned_pad), k=k, has_bans=wp > 0)
     scores, ixs = jax.device_get(out)
+    _record_dispatch("device", cells, time.perf_counter() - t0)
     return scores[:b], ixs[:b]
 
 
@@ -208,17 +348,28 @@ def topk_scores(user_vecs, item_factors, mask, *, k: int):
     traced = _is_traced(user_vecs, item_factors, mask)
     k = min(k, item_factors.shape[0])   # both paths clamp identically
     cells = user_vecs.shape[0] * item_factors.shape[0]
-    if traced or _on_device(user_vecs, item_factors) \
-            or cells >= HOST_CROSSOVER_CELLS:
-        DISPATCH_COUNTS["device"] += 1
-        if not traced:
-            item_factors = device_resident(item_factors)
-        out = _topk_scores_device(user_vecs, item_factors, mask, k=k)
-        return out if traced else jax.device_get(out)
-    DISPATCH_COUNTS["host"] += 1
-    scores = np.asarray(user_vecs) @ np.asarray(item_factors).T
-    scores = np.where(np.asarray(mask), scores, np.float32(NEG_INF))
-    return _topk_host(scores, k)
+    if traced:
+        _record_dispatch("device", cells)
+        return _topk_scores_device(user_vecs, item_factors, mask, k=k)
+    if _on_device(user_vecs, item_factors) \
+            or DISPATCH_POLICY.choose(cells) == "device":
+        t0 = time.perf_counter()
+        item_factors = device_resident(item_factors)
+        out = jax.device_get(
+            _topk_scores_device(user_vecs, item_factors, mask, k=k))
+        _record_dispatch("device", cells, time.perf_counter() - t0)
+        return out
+    t0 = time.perf_counter()
+    DISPATCH_POLICY.host_begin()
+    try:
+        scores = np.asarray(user_vecs) @ np.asarray(item_factors).T  # lint: ok
+        scores = np.where(np.asarray(mask), scores,  # lint: ok — host mask
+                          np.float32(NEG_INF))
+        out = _topk_host(scores, k)
+    finally:
+        DISPATCH_POLICY.host_end()
+    _record_dispatch("host", cells, time.perf_counter() - t0)
+    return out
 
 
 def topk_similar(query_vecs, item_factors, mask, *, k: int):
@@ -229,20 +380,31 @@ def topk_similar(query_vecs, item_factors, mask, *, k: int):
     traced = _is_traced(query_vecs, item_factors, mask)
     k = min(k, item_factors.shape[0])   # both paths clamp identically
     cells = query_vecs.shape[0] * item_factors.shape[0]
-    if traced or _on_device(query_vecs, item_factors) \
-            or cells >= HOST_CROSSOVER_CELLS:
-        DISPATCH_COUNTS["device"] += 1
-        if not traced:
-            item_factors = device_resident(item_factors)
-        out = _topk_similar_device(query_vecs, item_factors, mask, k=k)
-        return out if traced else jax.device_get(out)
-    DISPATCH_COUNTS["host"] += 1
-    q = np.asarray(query_vecs)
-    f = np.asarray(item_factors)
-    qn = q / (np.linalg.norm(q, axis=-1, keepdims=True) + 1e-9)
-    fn = f / (np.linalg.norm(f, axis=-1, keepdims=True) + 1e-9)
-    scores = np.where(np.asarray(mask), qn @ fn.T, np.float32(NEG_INF))
-    return _topk_host(scores, k)
+    if traced:
+        _record_dispatch("device", cells)
+        return _topk_similar_device(query_vecs, item_factors, mask, k=k)
+    if _on_device(query_vecs, item_factors) \
+            or DISPATCH_POLICY.choose(cells) == "device":
+        t0 = time.perf_counter()
+        item_factors = device_resident(item_factors)
+        out = jax.device_get(
+            _topk_similar_device(query_vecs, item_factors, mask, k=k))
+        _record_dispatch("device", cells, time.perf_counter() - t0)
+        return out
+    t0 = time.perf_counter()
+    DISPATCH_POLICY.host_begin()
+    try:
+        q = np.asarray(query_vecs)      # lint: ok — host-path arrays
+        f = np.asarray(item_factors)    # lint: ok — host-path arrays
+        qn = q / (np.linalg.norm(q, axis=-1, keepdims=True) + 1e-9)
+        fn = f / (np.linalg.norm(f, axis=-1, keepdims=True) + 1e-9)
+        scores = np.where(np.asarray(mask), qn @ fn.T,  # lint: ok
+                          np.float32(NEG_INF))
+        out = _topk_host(scores, k)
+    finally:
+        DISPATCH_POLICY.host_end()
+    _record_dispatch("host", cells, time.perf_counter() - t0)
+    return out
 
 
 def build_mask(n_items: int,
@@ -253,9 +415,126 @@ def build_mask(n_items: int,
     to indexes by the caller via BiMap and simply absent here)."""
     if whitelist_ix is not None:
         mask = np.zeros(n_items, bool)
-        mask[np.asarray(list(whitelist_ix), int)] = True
+        mask[np.asarray(list(whitelist_ix), int)] = True  # lint: ok
     else:
         mask = np.ones(n_items, bool)
     if len(blacklist_ix):
-        mask[np.asarray(list(blacklist_ix), int)] = False
+        mask[np.asarray(list(blacklist_ix), int)] = False  # lint: ok
     return np.broadcast_to(mask, (batch, n_items))
+
+
+# ---------------------------------------------------------------------------
+# The deploy-warmed serving plan: bucketed AOT executables.
+# ---------------------------------------------------------------------------
+
+# Batch buckets warmed by default (powers of two; the micro-batcher's
+# batch_max caps which of these a deployment actually compiles).
+DEFAULT_SERVE_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+class BucketedTopK:
+    """Per-model serving plan: banned-index top-k over a device-resident
+    factor matrix, one AOT-compiled executable per batch bucket.
+
+    Built once at deploy warmup (`Algorithm.warm_serving` via
+    `CoreWorkflow.prepare_deploy`):
+
+      - the factor matrix is device-put ONCE and pinned for the plan's
+        lifetime (no per-call re-transfer);
+      - every bucket in `buckets` is `.lower(...).compile()`d up front
+        with a FIXED banned width, so a serve call dispatches straight to
+        a compiled executable — the jit tracing cache is never consulted
+        and steady state is zero-recompile by construction (jaxprobe's
+        `pio_jax_backend_compiles_total` stays flat across drains);
+      - off-CPU, the padded query block and banned block are donated
+        (their buffers are dead after the call by construction).
+
+    A call pads the batch up to the smallest warmed bucket (padded lanes:
+    zero vectors + all-filler bans; they are sliced off before return and
+    can never leak into results) and pads/fills the banned block to the
+    fixed width with `n_items`, which the scatter drops. Batches larger
+    than the biggest bucket are chunked. Queries that DON'T fit the plan
+    (k above `self.k`, more bans than `banned_width`, whitelists or
+    category filters needing a dense mask) go through the generic
+    `topk_scores*` entry points instead — callers gate on `fits()`.
+    """
+
+    def __init__(self, item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+                 banned_width: int = 256):
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        self.n_items, self.rank = host.shape
+        self.k = max(1, min(k, self.n_items))
+        self.buckets = tuple(sorted({_next_pow2(b)
+                                     for b in buckets if b > 0})) or (1,)
+        self.banned_width = _next_pow2(max(1, banned_width))
+        # share the identity-keyed residency cache with the generic paths
+        # (keep the host alias alive so the weakref cache entry survives)
+        self._host_factors = host
+        self.factors = device_resident(host)
+        self._exe: dict = {}
+
+    def warm(self) -> int:
+        """AOT-lower/compile every bucket executable; returns how many
+        were compiled (idempotent: already-warm buckets are skipped)."""
+        fn = (_topk_scores_banned_device
+              if jax.default_backend() == "cpu"
+              else _topk_scores_banned_donated)
+        compiled = 0
+        for b in self.buckets:
+            if b in self._exe:
+                continue
+            vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
+            ban_spec = jax.ShapeDtypeStruct((b, self.banned_width),
+                                            np.int32)
+            self._exe[b] = fn.lower(vec_spec, self.factors, ban_spec,
+                                    k=self.k, has_bans=True).compile()
+            compiled += 1
+        return compiled
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def fits(self, *, max_banned: int, k: int) -> bool:
+        """Whether a batch with these parameters can use the plan."""
+        return (bool(self._exe)
+                and k <= self.k and max_banned <= self.banned_width)
+
+    def _bucket_for(self, b: int) -> int:
+        for bucket in self.buckets:
+            if bucket >= b:
+                return bucket
+        return self.max_bucket
+
+    def __call__(self, user_vecs, banned_lists: Sequence[Sequence[int]]):
+        """Score `user_vecs` [b, rank] against the resident factors with
+        per-row banned-index lists; returns host (scores [b, k],
+        indexes [b, k]). Pads to the bucket grid; chunks past the biggest
+        bucket."""
+        user_vecs = np.asarray(user_vecs, np.float32)  # lint: ok — host in
+        b = user_vecs.shape[0]
+        if b > self.max_bucket:
+            parts = [self(user_vecs[lo:lo + self.max_bucket],
+                          banned_lists[lo:lo + self.max_bucket])
+                     for lo in range(0, b, self.max_bucket)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        bucket = self._bucket_for(b)
+        exe = self._exe.get(bucket)
+        if exe is None:
+            raise RuntimeError(
+                f"BucketedTopK bucket {bucket} not warmed; call warm() "
+                "at deploy time")
+        t0 = time.perf_counter()
+        vecs = np.zeros((bucket, self.rank), np.float32)
+        vecs[:b] = user_vecs
+        banned = np.full((bucket, self.banned_width), self.n_items,
+                         np.int32)
+        for row, bl in enumerate(banned_lists):
+            if len(bl):
+                banned[row, :len(bl)] = np.asarray(bl, np.int32)  # lint: ok
+        scores, ixs = jax.device_get(exe(vecs, self.factors, banned))
+        _record_dispatch("device", bucket * self.n_items,
+                         time.perf_counter() - t0)
+        return scores[:b], ixs[:b]

@@ -54,6 +54,23 @@ BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
 
 _log = get_logger("serving")
 
+# shared executor for the per-algorithm fan-out in predict_batch: device
+# dispatch releases the GIL, so independent algorithms overlap. Module
+# level + lazy so /reload swapping deployments never leaks pools.
+_ALGO_POOL = None
+_ALGO_POOL_LOCK = threading.Lock()
+
+
+def _algo_pool():
+    global _ALGO_POOL
+    if _ALGO_POOL is None:
+        with _ALGO_POOL_LOCK:
+            if _ALGO_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _ALGO_POOL = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="pio-algo")
+    return _ALGO_POOL
+
 
 class _ServeInstruments:
     """The serve-chain metric families, shared by the server, its
@@ -174,28 +191,41 @@ class _Deployment:
         from the ensemble for this batch (counted in
         pio_algo_errors_total) and serving.serve runs on the surviving
         predictions — a degraded answer instead of a failed query. Only
-        when EVERY algorithm fails does the batch error."""
+        when EVERY algorithm fails does the batch error.
+
+        Multi-algorithm ensembles fan out across the shared algo pool —
+        device dispatch releases the GIL, so independent algorithms'
+        predict work overlaps; ordering and the isolation contract are
+        unchanged (results land positionally)."""
         obs = self.obs
+
+        def run_one(i, a, m):
+            label = f"{i}:{type(a).__name__}"
+            try:
+                faults().check(f"serve.predict.{label}")
+                with obs.algo.labels(algo=label).time():
+                    return dict(a.batch_predict(m, indexed)), None
+            except Exception as e:
+                obs.algo_errors.labels(algo=label).inc()
+                _log.warning(
+                    "algo_predict_failed", algo=label,
+                    error=f"{type(e).__name__}: {e}",
+                    degraded=len(self.algos) > 1)
+                return None, e
+
         with obs.stage.labels(stage="supplement").time():
             supplemented = [self.serving.supplement(q) for q in queries]
         indexed = list(enumerate(supplemented))
-        per_algo: List[Optional[Dict[int, Any]]] = []
-        errors: List[Exception] = []
         with obs.stage.labels(stage="predict").time():
-            for i, (a, m) in enumerate(zip(self.algos, self.models)):
-                label = f"{i}:{type(a).__name__}"
-                try:
-                    faults().check(f"serve.predict.{label}")
-                    with obs.algo.labels(algo=label).time():
-                        per_algo.append(dict(a.batch_predict(m, indexed)))
-                except Exception as e:
-                    errors.append(e)
-                    per_algo.append(None)
-                    obs.algo_errors.labels(algo=label).inc()
-                    _log.warning(
-                        "algo_predict_failed", algo=label,
-                        error=f"{type(e).__name__}: {e}",
-                        degraded=len(self.algos) > 1)
+            if len(self.algos) == 1:
+                outcomes = [run_one(0, self.algos[0], self.models[0])]
+            else:
+                futures = [
+                    _algo_pool().submit(run_one, i, a, m)
+                    for i, (a, m) in enumerate(zip(self.algos, self.models))]
+                outcomes = [f.result() for f in futures]
+        per_algo = [pa for pa, _ in outcomes]
+        errors = [e for _, e in outcomes if e is not None]
         alive = [pa for pa in per_algo if pa is not None]
         if not alive:
             raise errors[0]
@@ -239,6 +269,10 @@ class _MicroBatcher:
         self.submit_timeout_s = submit_timeout_s
         self.obs = obs if obs is not None else _ServeInstruments()
         self._lock = threading.Lock()
+        # wakes the drainer the moment a full batch forms, so a batch
+        # that fills mid-window ships immediately instead of sleeping
+        # out the rest of the window
+        self._full = threading.Condition(self._lock)
         # each item: (deployment, query, done event, result slot)
         self._pending: List[tuple] = []
         self._draining = False
@@ -256,6 +290,8 @@ class _MicroBatcher:
                     retry_after=max(self.window_s, 0.05))
             self._pending.append(item)
             self.obs.queue_depth.set(float(len(self._pending)))
+            if len(self._pending) >= self.batch_max:
+                self._full.notify()
             drain = not self._draining
             if drain:
                 self._draining = True
@@ -287,12 +323,11 @@ class _MicroBatcher:
         try:
             while True:
                 with self._lock:
-                    full = len(self._pending) >= self.batch_max
-                if not full:
-                    # only wait out the window when a full batch isn't
-                    # already queued — a formed batch ships immediately
-                    time.sleep(self.window_s)  # lint: ok — batch window
-                with self._lock:
+                    # wait out the window — but a full batch forming
+                    # mid-window notifies the condition and ships NOW
+                    self._full.wait_for(
+                        lambda: len(self._pending) >= self.batch_max,
+                        timeout=self.window_s)
                     batch = self._pending[:self.batch_max]
                     self._pending = self._pending[self.batch_max:]
                     self.obs.queue_depth.set(float(len(self._pending)))
@@ -416,8 +451,12 @@ class PredictionServer(HTTPServerBase):
                       else resolve_engine(self.config.engine_factory))
             if instance is None:
                 instance = self._resolve_instance()
+            # warm the pow2 buckets the micro-batcher can actually form;
+            # without batching only the single-query shape matters
             algos, models, serving = CoreWorkflow.prepare_deploy(
-                engine, instance, self.ctx)
+                engine, instance, self.ctx,
+                warm_batch_max=(self.config.batch_max
+                                if self._batcher is not None else 1))
         except Exception:
             self._serve_obs.reloads.labels(outcome="failed").inc()
             raise

@@ -131,6 +131,30 @@ def _series_rows(name: str, fam: dict) -> list:
     return rows
 
 
+# serving-performance families: the "is the hot path on the device" view
+# (dispatch mix, backend recompiles, deploy warmup cost, coalesced batch
+# sizes)
+_SERVING_PREFIXES = ("pio_topk_dispatch", "pio_jax_backend_compile",
+                     "pio_serve_warmup", "pio_serve_batch_size")
+
+
+def _serving_panel(snapshot: dict) -> str:
+    """Summary table of the serve-pipeline families so an operator sees
+    the host/device dispatch mix, steady-state recompiles (should be
+    flat after warmup), and warmup cost at a glance."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_SERVING_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Serving performance</h2>"
+                "<p>No dispatch/compile/warmup activity recorded yet.</p>")
+    return ("<h2>Serving performance</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
+
+
 def _durability_panel(snapshot: dict) -> str:
     """Summary table of the resilience/durability families so an operator
     sees breaker trips, fsck quarantines, janitored trains, and exhausted
@@ -164,7 +188,7 @@ def _metrics_page(metrics: MetricsRegistry) -> str:
         "<meta http-equiv='refresh' content='5'></head>"
         "<body><h1>Live metrics</h1>"
         "<p>Prometheus text format: <a href='/metrics'>/metrics</a></p>"
-        + _durability_panel(snapshot) +
+        + _serving_panel(snapshot) + _durability_panel(snapshot) +
         "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
         "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")

@@ -27,6 +27,11 @@ Checks, per source file:
     a torn file; go through ``data.integrity.atomic_write_bytes`` (tmp +
     fsync + rename). Lines mentioning ``.tmp`` (the staging file of the
     atomic pattern itself) or marked ``# lint: ok`` are allowed
+  - device serve hot paths (ops/topk.py, serving/) must not coerce with
+    ``np.asarray``/``np.array`` or bare ``float()``/``int()`` — on a jax
+    array each is an implicit device->host transfer that blocks the
+    accelerator mid-pipeline; read back once per dispatch with
+    ``jax.device_get`` (known-host inputs: ``# lint: ok``)
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -59,6 +64,11 @@ _STORAGE_DIRS = ("predictionio_tpu/data/storage/",)
 # everything on a request or storage path must finish or fail in
 # bounded time (predictionio_tpu.resilience supplies the bounded forms)
 _RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/")
+
+# device hot paths: implicit device->host transfers (np.asarray /
+# np.array / float() on a jax array) force a blocking sync per call
+_DEVICE_HOT_PATHS = ("predictionio_tpu/ops/topk.py",
+                     "predictionio_tpu/serving/")
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -246,6 +256,59 @@ def _check_storage_writes(tree: ast.AST, text: str,
                "data.integrity.atomic_write_bytes (or mark '# lint: ok')")
 
 
+def _check_device_transfers(tree: ast.AST, text: str,
+                            rel: str) -> Iterator[str]:
+    """On the device serve hot paths (ops/topk.py, serving/): forbid
+    ``np.asarray(``/``np.array(`` and ``float(``/``int(`` coercions —
+    each one is a potential implicit device->host transfer that blocks
+    on the accelerator and re-serializes the pipeline. The sanctioned
+    forms are explicit: ``jax.device_get(...)`` for one batched readback
+    per dispatch, or ``# lint: ok`` on a line whose input is known
+    host-resident. ``float(``/``int(`` on obvious host scalars
+    (constants, ``len(...)``, each other) pass without annotation."""
+    if not rel.startswith(_DEVICE_HOT_PATHS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in ("asarray", "array") \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("np", "numpy"):
+            yield (f"{rel}:{node.lineno}: np.{fn.attr}() on a device "
+                   "hot path is an implicit device->host transfer; use "
+                   "jax.device_get once per dispatch, or mark "
+                   "'# lint: ok' for known-host inputs")
+        elif isinstance(fn, ast.Name) and fn.id in ("float", "int") \
+                and node.args:
+            arg = node.args[0]
+            # host-scalar coercions are fine: literals, len()/int()/
+            # float()/min()/max() results, attribute constants
+            if isinstance(arg, ast.Constant):
+                continue
+            if isinstance(arg, ast.Call) \
+                    and isinstance(arg.func, ast.Name) \
+                    and arg.func.id in ("len", "int", "float", "min",
+                                        "max", "round"):
+                continue
+            # method-call results (os.environ.get, dict lookups) are
+            # host values; device reads go through jax.device_get first
+            if isinstance(arg, ast.Call) \
+                    and isinstance(arg.func, ast.Attribute):
+                continue
+            if isinstance(arg, (ast.BinOp, ast.Attribute)):
+                continue
+            yield (f"{rel}:{node.lineno}: {fn.id}() coercion on a "
+                   "device hot path may force a device sync; coerce "
+                   "after jax.device_get, or mark '# lint: ok' for "
+                   "host values")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -265,6 +328,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_instrumentation(tree, text, rel))
     out.extend(_check_bounded_waits(tree, text, rel))
     out.extend(_check_storage_writes(tree, text, rel))
+    out.extend(_check_device_transfers(tree, text, rel))
     return out
 
 

@@ -1,0 +1,326 @@
+"""Device-resident serve pipeline tests: bucketed AOT plans (padding
+correctness, zero-recompile steady state), the amortized dispatch
+policy, the concurrent per-algorithm fan-out, and the micro-batcher's
+full-batch condition-variable wakeup."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import compile_watch, get_registry
+from predictionio_tpu.ops import topk
+from predictionio_tpu.serving.server import _Deployment, _MicroBatcher
+
+
+def _host_reference(vecs, factors, banned_lists, k):
+    out_s, out_ix = [], []
+    for row in range(vecs.shape[0]):
+        sc = vecs[row] @ factors.T
+        if banned_lists[row]:
+            sc[np.asarray(banned_lists[row], int)] = topk.NEG_INF
+        order = np.argsort(-sc, kind="stable")[:k]
+        out_ix.append(order)
+        out_s.append(sc[order])
+    return np.array(out_s), np.array(out_ix)
+
+
+@pytest.fixture()
+def plan_and_factors():
+    rng = np.random.default_rng(7)
+    # integer-valued factors: host f32 BLAS and device HIGHEST matmul
+    # agree bitwise, so parity checks are exact
+    factors = rng.integers(-4, 5, size=(200, 8)).astype(np.float32)
+    plan = topk.BucketedTopK(factors, k=6, buckets=(1, 2, 4, 8),
+                             banned_width=8)
+    assert plan.warm() == 4
+    return plan, factors
+
+
+class TestBucketedTopK:
+    def test_padded_lanes_never_leak(self, plan_and_factors):
+        plan, factors = plan_and_factors
+        rng = np.random.default_rng(1)
+        # batch 3 pads to bucket 4; batch 5 pads to 8
+        for b in (3, 5):
+            vecs = rng.integers(-4, 5, size=(b, 8)).astype(np.float32)
+            banned = [sorted(rng.choice(200, size=rng.integers(0, 8),
+                                        replace=False).tolist())
+                      for _ in range(b)]
+            s, ix = plan(vecs, banned)
+            assert s.shape == (b, 6) and ix.shape == (b, 6)
+            ref_s, ref_ix = _host_reference(vecs, factors, banned, 6)
+            assert np.array_equal(s, ref_s)
+            assert np.array_equal(ix, ref_ix)
+            for row in range(b):
+                assert not set(ix[row].tolist()) & set(banned[row])
+
+    def test_chunks_past_largest_bucket(self, plan_and_factors):
+        plan, factors = plan_and_factors
+        rng = np.random.default_rng(2)
+        vecs = rng.integers(-4, 5, size=(19, 8)).astype(np.float32)
+        banned = [[] for _ in range(19)]
+        s, ix = plan(vecs, banned)
+        assert s.shape == (19, 6)
+        ref_s, ref_ix = _host_reference(vecs, factors, banned, 6)
+        assert np.array_equal(s, ref_s) and np.array_equal(ix, ref_ix)
+
+    def test_zero_recompiles_across_every_bucket_size(
+            self, plan_and_factors):
+        plan, _ = plan_and_factors
+        rng = np.random.default_rng(3)
+        with compile_watch() as w:
+            for _ in range(2):          # every size, twice
+                for b in range(1, 9):
+                    vecs = rng.integers(-4, 5, size=(b, 8)).astype(
+                        np.float32)
+                    plan(vecs, [[0]] * b)
+        assert w.count == 0
+
+    def test_fits_rejects_oversized_queries(self, plan_and_factors):
+        plan, _ = plan_and_factors
+        assert plan.fits(max_banned=8, k=6)
+        assert not plan.fits(max_banned=9, k=6)     # > banned_width
+        assert not plan.fits(max_banned=0, k=7)     # > warmed k
+        cold = topk.BucketedTopK(np.ones((10, 4), np.float32), k=3)
+        assert not cold.fits(max_banned=0, k=1)     # never warmed
+        with pytest.raises(RuntimeError, match="not warmed"):
+            cold(np.ones((1, 4), np.float32), [[]])
+
+    def test_warm_is_idempotent(self, plan_and_factors):
+        plan, _ = plan_and_factors
+        assert plan.warm() == 0
+
+
+class TestDispatchPolicy:
+    def test_cold_start_matches_static_crossover(self):
+        p = topk.DispatchPolicy()
+        assert p.choose(topk.HOST_CROSSOVER_CELLS) == "device"
+        assert p.choose(topk.HOST_CROSSOVER_CELLS - 1) == "host"
+
+    def test_promotion_needs_both_ewmas_and_the_floor(self):
+        p = topk.DispatchPolicy()
+        cells = max(topk.PROMOTE_FLOOR_CELLS,
+                    topk.HOST_CROSSOVER_CELLS // 4)
+        p.observe("host", cells, 1.0)        # slow host
+        assert p.choose(cells) == "host"     # device EWMA still unknown
+        p.observe("device", cells, 1e-4)     # fast device
+        assert p.choose(cells) == "device"   # promoted below crossover
+        # tiny problems never promote, whatever the EWMAs say
+        assert p.choose(topk.PROMOTE_FLOOR_CELLS - 1) == "host"
+
+    def test_slow_device_stays_host(self):
+        p = topk.DispatchPolicy()
+        cells = max(topk.PROMOTE_FLOOR_CELLS,
+                    topk.HOST_CROSSOVER_CELLS // 4)
+        p.observe("host", cells, 1e-4)       # fast host
+        p.observe("device", cells, 10.0)     # terrible device
+        assert p.choose(cells) == "host"
+
+    def test_inflight_coalescing_pulls_toward_device(self):
+        p = topk.DispatchPolicy()
+        cells = max(topk.PROMOTE_FLOOR_CELLS,
+                    topk.HOST_CROSSOVER_CELLS // 4)
+        p.observe("host", cells, 5e-4)
+        p.observe("device", cells, 1e-3)     # 2x the idle host cost
+        assert p.choose(cells) == "host"     # idle host still wins
+        p.host_begin()
+        p.host_begin()                       # 2 host calls in flight
+        assert p.choose(cells) == "device"   # coalescing term flips it
+        p.host_end()
+        p.host_end()
+        assert p.snapshot()["host_inflight"] == 0
+
+    def test_record_dispatch_exports_metric(self):
+        reg = get_registry()
+        before = reg.value("pio_topk_dispatch_total", path="device")
+        counts_before = topk.DISPATCH_COUNTS["device"]
+        topk._record_dispatch("device", 100, 0.001)
+        assert topk.DISPATCH_COUNTS["device"] == counts_before + 1
+        assert reg.value("pio_topk_dispatch_total",
+                         path="device") == before + 1
+
+
+class _EchoAlgo:
+    query_class = None
+    params = None
+
+    def __init__(self, tag, barrier=None, fail=False):
+        self.tag = tag
+        self.barrier = barrier
+        self.fail = fail
+
+    def batch_predict(self, model, queries):
+        if self.barrier is not None:
+            # only passes when BOTH algorithms run concurrently
+            self.barrier.wait(timeout=5.0)
+        if self.fail:
+            raise ValueError(f"{self.tag} exploded")
+        return [(i, f"{self.tag}:{q}") for i, q in queries]
+
+
+class _PassthroughServing:
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _deployment(algos):
+    class _Inst:
+        id = "t"
+        engine_variant = "default"
+    return _Deployment(None, _Inst(), algos,
+                       [None] * len(algos), _PassthroughServing())
+
+
+class TestConcurrentPredict:
+    def test_algorithms_run_concurrently(self):
+        barrier = threading.Barrier(2)
+        dep = _deployment([_EchoAlgo("a", barrier),
+                           _EchoAlgo("b", barrier)])
+        # sequential execution would deadlock both on the barrier and
+        # fail the batch; concurrency is what lets this return
+        assert dep.predict_batch(["q1", "q2"]) == ["a:q1", "a:q2"]
+
+    def test_error_isolation_survives_concurrency(self):
+        dep = _deployment([_EchoAlgo("bad", fail=True), _EchoAlgo("ok")])
+        assert dep.predict_batch(["q"]) == ["ok:q"]
+
+    def test_all_algorithms_failing_raises(self):
+        dep = _deployment([_EchoAlgo("x", fail=True),
+                           _EchoAlgo("y", fail=True)])
+        with pytest.raises(ValueError, match="exploded"):
+            dep.predict_batch(["q"])
+
+
+class _InstantDep:
+    query_class = None
+
+    def predict_batch(self, queries):
+        return [f"r:{q}" for q in queries]
+
+
+class TestDrainerWakeup:
+    def test_full_batch_ships_before_window_expires(self):
+        # window is 5s; a full batch must NOT wait it out
+        mb = _MicroBatcher(window_s=5.0, batch_max=4)
+        dep = _InstantDep()
+        results = {}
+
+        def worker(n):
+            results[n] = mb.submit(dep, f"q{n}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=4.0)
+        elapsed = time.perf_counter() - t0
+        assert results == {n: f"r:q{n}" for n in range(4)}
+        assert elapsed < 2.0, (
+            f"full batch waited {elapsed:.2f}s — condition wakeup broken")
+
+    def test_partial_batch_still_drains_after_window(self):
+        mb = _MicroBatcher(window_s=0.02, batch_max=64)
+        assert mb.submit(_InstantDep(), "solo") == "r:solo"
+
+
+@pytest.fixture()
+def trained_rec(mem_registry):
+    """Registry with a trained recommendation instance (the warmup
+    integration surface)."""
+    from predictionio_tpu.core import (
+        CoreWorkflow, EngineParams, RuntimeContext,
+    )
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.models import recommendation as rec
+
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "warmapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(12):
+        for i in range(15):
+            if rng.rand() > 0.6:
+                continue
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + i % 5)})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="warmapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=3,
+                                           seed=1)),))
+    CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine
+
+
+class TestDeployWarmup:
+    def _start(self, registry, engine, **cfg):
+        from predictionio_tpu.serving import PredictionServer, ServerConfig
+        srv = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, **cfg),
+            registry=registry, engine=engine)
+        srv.start()
+        return srv
+
+    def _query(self, port, user, num=3):
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/queries.json",
+            data=json.dumps({"user": user, "num": num}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    def test_deploy_builds_plan_and_steady_state_is_recompile_free(
+            self, trained_rec):
+        registry, engine = trained_rec
+        srv = self._start(registry, engine)
+        try:
+            plan = getattr(srv._dep.algos[0], "_serve_plan", None)
+            assert plan is not None, "warm_serving did not run at deploy"
+            # batching off -> only the single-query bucket is warmed
+            assert tuple(plan._exe) == (1,)
+            self._query(srv.port, "u1")     # settle any non-topk lazies
+            with compile_watch() as w:
+                for q in range(6):
+                    res = self._query(srv.port, f"u{q % 12}")
+                    assert len(res["itemScores"]) == 3
+            assert w.count == 0, (
+                f"{w.count} recompiles in steady state — the AOT plan "
+                "is not being dispatched")
+        finally:
+            srv.shutdown()
+
+    def test_batcher_caps_warmed_buckets(self, trained_rec):
+        registry, engine = trained_rec
+        srv = self._start(registry, engine, batch_window_ms=2,
+                          batch_max=8)
+        try:
+            plan = srv._dep.algos[0]._serve_plan
+            assert tuple(plan._exe) == (1, 2, 4, 8)
+        finally:
+            srv.shutdown()
+
+    def test_warmup_env_off(self, trained_rec, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_WARMUP", "off")
+        registry, engine = trained_rec
+        srv = self._start(registry, engine)
+        try:
+            assert getattr(srv._dep.algos[0], "_serve_plan", None) is None
+            # the generic dispatch path still serves correctly
+            assert len(self._query(srv.port, "u1")["itemScores"]) == 3
+        finally:
+            srv.shutdown()

@@ -158,3 +158,50 @@ def test_storage_write_gate_scoped_to_storage_drivers(tmp_path):
         "    path.write_bytes(blob)\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_device_transfer_gate_catches_implicit_syncs(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "sync.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import numpy as np\n"
+        "def f(dev_scores, row):\n"
+        "    host = np.asarray(dev_scores)\n"
+        "    copy = np.array(dev_scores)\n"
+        "    s = float(dev_scores)\n"
+        "    return host, copy, s\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "np.asarray() on a device hot path" in kinds
+    assert "np.array() on a device hot path" in kinds
+    assert "float() coercion on a device hot path" in kinds
+    assert "jax.device_get" in kinds
+
+
+def test_device_transfer_gate_allows_host_scalars_and_escape(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "serving" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import numpy as np\n"
+        "def f(pending, raw, cfg):\n"
+        "    depth = float(len(pending))\n"       # len() is host
+        "    ms = float(cfg.window_ms)\n"         # attribute constant
+        "    arr = np.asarray(raw)  # lint: ok\n"
+        "    return depth, ms, arr\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_device_transfer_gate_scoped_to_hot_paths(tmp_path):
+    # models/ assemble host-side results; np coercions are their job
+    ok = tmp_path / "predictionio_tpu" / "models" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import numpy as np\n"
+        "def f(scores):\n"
+        "    return float(np.asarray(scores)[0])\n"
+    )
+    assert not lint.run(tmp_path)
