@@ -77,9 +77,14 @@ class Engine:
         tm = ctx.phase_timings
         tm.clear()   # a reused context must not leak a previous run's
         # phases into this instance's persisted record
+        from predictionio_tpu.ingest.pipeline import take_phase_timings
+        take_phase_timings()   # drop a previous run's ingest stages
         t0 = _time.perf_counter()
         td = ds.read_training(ctx)
         tm["read_s"] = round(_time.perf_counter() - t0, 4)
+        # read_s subdivided: scan/build/transfer + cache hit counters from
+        # the columnar ingest pipeline, when the data source used it
+        tm.update({k: round(v, 4) for k, v in take_phase_timings().items()})
         if not wp.skip_sanity_check:
             sanity_check(td)
         if wp.stop_after_read:

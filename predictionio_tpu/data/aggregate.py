@@ -50,18 +50,8 @@ class EventOp:
 
     @staticmethod
     def from_event(e: Event) -> "EventOp":
-        t = to_millis(e.event_time)
-        if e.event == "$set":
-            return EventOp(
-                set_fields={k: (v, t) for k, v in e.properties.fields.items()},
-                set_t=t, first_updated=t, last_updated=t)
-        if e.event == "$unset":
-            return EventOp(
-                unset_fields={k: t for k in e.properties.keySet()},
-                first_updated=t, last_updated=t)
-        if e.event == "$delete":
-            return EventOp(delete_t=t, first_updated=t, last_updated=t)
-        return EventOp()
+        return op_from_parts(e.event, e.properties.fields,
+                             to_millis(e.event_time))
 
     def combine(self, other: "EventOp") -> "EventOp":
         """Associative combine (`EventOp.++`); commutative up to equal-time
@@ -111,6 +101,25 @@ class EventOp:
             first_updated=from_millis(self.first_updated),
             last_updated=from_millis(self.last_updated),
         )
+
+
+def op_from_parts(name: str, fields: Optional[Mapping[str, object]],
+                  t: int) -> EventOp:
+    """EventOp from raw frame parts (event name, property dict,
+    event-time millis) — the zero-Event aggregation path PEVLOG's
+    columnar `aggregate_properties` uses; `EventOp.from_event` is the
+    Event-object adapter over it."""
+    if name == "$set":
+        return EventOp(
+            set_fields={k: (v, t) for k, v in (fields or {}).items()},
+            set_t=t, first_updated=t, last_updated=t)
+    if name == "$unset":
+        return EventOp(
+            unset_fields={k: t for k in (fields or {})},
+            first_updated=t, last_updated=t)
+    if name == "$delete":
+        return EventOp(delete_t=t, first_updated=t, last_updated=t)
+    return EventOp()
 
 
 def _max_opt(a: Optional[int], b: Optional[int]) -> Optional[int]:

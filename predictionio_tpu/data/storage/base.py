@@ -411,6 +411,51 @@ class EventStore(abc.ABC):
         replayed."""
 
     # -- derived operations --------------------------------------------------
+    def scan_columns(self, app_id: int, channel_id: Optional[int] = None, *,
+                     start_time: Optional[datetime] = None,
+                     until_time: Optional[datetime] = None,
+                     entity_type: Optional[str] = None,
+                     entity_id: Optional[str] = None,
+                     event_names: Optional[Sequence[str]] = None,
+                     target_entity_type: object = _UNSET,
+                     target_entity_id: object = _UNSET,
+                     properties: Optional[Dict[str, object]] = None,
+                     value_spec=None, require_target: bool = True,
+                     workers: Optional[int] = None):
+        """Columnar training scan: `find` filter semantics, but the
+        result is an `EventColumns` struct (interned int32 entity ids,
+        float32 values per `value_spec`, int64 event times) instead of
+        an Event iterator — the zero-object path template DataSources
+        feed into `RatingColumns.from_store`/`PairColumns.from_store`.
+
+        This base implementation adapts `find()` (drivers keep their
+        own pushdown); PEVLOG overrides it with a chunk-parallel
+        raw-frame decode. `workers` is advisory — a driver without a
+        parallel scan ignores it."""
+        from predictionio_tpu.data.storage.columns import columns_from_events
+        return columns_from_events(
+            self.find(app_id, channel_id, start_time=start_time,
+                      until_time=until_time, entity_type=entity_type,
+                      entity_id=entity_id, event_names=event_names,
+                      target_entity_type=target_entity_type,
+                      target_entity_id=target_entity_id,
+                      properties=properties),
+            value_spec, require_target)
+
+    def ingest_watermark(self, app_id: int,
+                         channel_id: Optional[int] = None
+                         ) -> Optional[Dict[str, int]]:
+        """Monotone content fingerprint for the prepared-data cache:
+        any insert/delete must change it. None (the default) disables
+        caching for this driver."""
+        return None
+
+    def ingest_cache_dir(self, app_id: int,
+                         channel_id: Optional[int] = None):
+        """Directory for prepared-data cache blobs, or None when the
+        driver has no natural on-disk home for them."""
+        return None
+
     def aggregate_properties(self, app_id: int,
                              channel_id: Optional[int] = None, *,
                              entity_type: str,

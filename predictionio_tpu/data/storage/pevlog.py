@@ -55,6 +55,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import pickle
 import re
 import threading
 from base64 import b64decode, b64encode
@@ -65,11 +66,14 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from predictionio_tpu.data import integrity
 from predictionio_tpu.data.event import DataMap, Event
-from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage import base, columns
+from predictionio_tpu.data.storage._scanworker import scan_chunk
 from predictionio_tpu.data.storage.evlog import (
     _from_us, _payload_to_event, _us,
 )
-from predictionio_tpu.native.eventlog import EventLog, framed_size
+from predictionio_tpu.native.eventlog import (
+    EventLog, MAGIC, _HEADER, framed_size,
+)
 
 
 def _compact_payload(e: Event) -> bytes:
@@ -781,7 +785,11 @@ class PevlogEvents(base.EventStore):
                 for p in part.iterdir():
                     self.c.replay_cache.pop(str(p), None)
                     self.c.index_cache.pop(str(p), None)
-                    p.unlink()
+                    if p.is_dir():       # _prepared ingest cache
+                        import shutil
+                        shutil.rmtree(p, ignore_errors=True)
+                    else:
+                        p.unlink()
                 part.rmdir()
         return True
 
@@ -1041,6 +1049,34 @@ class PevlogEvents(base.EventStore):
                             "tus": tus}).encode())
         return True
 
+    @staticmethod
+    def _segment_survives(ix: _SegmentIndex, *, start_us, until_us,
+                          entity_type, entity_id, event_names,
+                          target_entity_type, target_entity_id,
+                          properties) -> bool:
+        """Index pushdown shared by `find` and `scan_columns`: True iff
+        the segment may hold a matching event and must be replayed."""
+        if not ix.overlaps(start_us, until_us):
+            return False
+        if entity_type is not None and entity_id is not None \
+                and not ix.may_contain(entity_type, entity_id):
+            return False
+        if event_names and not ix.may_contain_event(event_names):
+            return False
+        if isinstance(target_entity_type, str) \
+                and isinstance(target_entity_id, str) \
+                and not ix.may_contain_target(target_entity_type,
+                                              target_entity_id):
+            return False
+        # a matching event must carry EVERY filter pair, so one pair
+        # definitely absent from the segment prunes it (the ES
+        # query-DSL pushdown role, at skip-index granularity)
+        if properties and any(
+                not ix.may_contain_property(k, v)
+                for k, v in properties.items()):
+            return False
+        return True
+
     def find(self, app_id: int, channel_id: Optional[int] = None, *,
              start_time=None, until_time=None, entity_type=None,
              entity_id=None, event_names=None,
@@ -1055,29 +1091,13 @@ class PevlogEvents(base.EventStore):
         dead = self._tombstones(part)
         events: List[Event] = []
         for seg in self._segments(part):
-            ix = self._index(seg)
-            if not ix.overlaps(start_us, until_us):
-                self.c.stats["segments_pruned"] += 1
-                continue
-            if entity_type is not None and entity_id is not None \
-                    and not ix.may_contain(entity_type, entity_id):
-                self.c.stats["segments_pruned"] += 1
-                continue
-            if event_names and not ix.may_contain_event(event_names):
-                self.c.stats["segments_pruned"] += 1
-                continue
-            if isinstance(target_entity_type, str) \
-                    and isinstance(target_entity_id, str) \
-                    and not ix.may_contain_target(target_entity_type,
-                                                  target_entity_id):
-                self.c.stats["segments_pruned"] += 1
-                continue
-            # a matching event must carry EVERY filter pair, so one pair
-            # definitely absent from the segment prunes it (the ES
-            # query-DSL pushdown role, at skip-index granularity)
-            if properties and any(
-                    not ix.may_contain_property(k, v)
-                    for k, v in properties.items()):
+            if not self._segment_survives(
+                    self._index(seg), start_us=start_us, until_us=until_us,
+                    entity_type=entity_type, entity_id=entity_id,
+                    event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    target_entity_id=target_entity_id,
+                    properties=properties):
                 self.c.stats["segments_pruned"] += 1
                 continue
             self.c.stats["segments_scanned"] += 1
@@ -1096,3 +1116,309 @@ class PevlogEvents(base.EventStore):
         if limit is not None and limit > 0:
             events = events[:limit]
         return iter(events)
+
+    # -- columnar training scan ---------------------------------------------
+    def scan_columns(self, app_id: int, channel_id: Optional[int] = None, *,
+                     start_time=None, until_time=None, entity_type=None,
+                     entity_id=None, event_names=None,
+                     target_entity_type=base._UNSET,
+                     target_entity_id=base._UNSET,
+                     properties=None, value_spec=None,
+                     require_target: bool = True,
+                     workers: Optional[int] = None) -> "columns.EventColumns":
+        """`find()` semantics, columnar output: identical index pushdown
+        and post-filters, but matching frames decode straight into numpy
+        columns (no Event/datetime/DataMap per frame) on a chunked
+        `PIO_INGEST_WORKERS` process pool. Segments whose Event replay
+        is already cached at the current journal size reuse it instead
+        of re-reading the journal; segments the raw path can't reproduce
+        exactly (legacy frames, in-journal tombstones, external ids)
+        fall back to the Event replay per segment. Output is invariant
+        under worker count and byte-equivalent to
+        `columns_from_events(self.find(...))`."""
+        procs = ingest_workers(workers)
+        part = self._part_dir(app_id, channel_id)
+        start_us = _us(start_time) if start_time is not None else None
+        until_us = _us(until_time) if until_time is not None else None
+        dead = self._tombstones(part)
+        spec = columns.normalize_value_spec(value_spec)
+        filters = dict(start_time=start_time, until_time=until_time,
+                       entity_type=entity_type, entity_id=entity_id,
+                       event_names=event_names,
+                       target_entity_type=target_entity_type,
+                       target_entity_id=target_entity_id,
+                       properties=properties)
+        if len(dead) > _DEAD_SHIP_MAX:
+            # the worker cfg ships the tombstone map with every chunk; a
+            # huge one makes the Event path the cheaper option
+            return columns.columns_from_events(
+                self.find(app_id, channel_id, **filters),
+                value_spec, require_target)
+        cfg_blob = pickle.dumps(
+            {"start_us": start_us, "until_us": until_us,
+             "entity_type": entity_type, "entity_id": entity_id,
+             "event_names": frozenset(event_names) if event_names else None,
+             "tet": columns.encode_target(target_entity_type, base._UNSET),
+             "tei": columns.encode_target(target_entity_id, base._UNSET),
+             "properties": dict(properties) if properties else None,
+             "value_spec": spec, "require_target": require_target,
+             "dead": dict(dead)},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        pool = _scan_pool(procs) if procs > 1 else None
+        plan: List[tuple] = []
+        for seg in self._segments(part):
+            if not self._segment_survives(
+                    self._index(seg), start_us=start_us, until_us=until_us,
+                    entity_type=entity_type, entity_id=entity_id,
+                    event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    target_entity_id=target_entity_id,
+                    properties=properties):
+                self.c.stats["segments_pruned"] += 1
+                continue
+            self.c.stats["segments_scanned"] += 1
+            key = str(seg)
+            try:
+                size = seg.stat().st_size
+            except OSError:
+                continue
+            cached = self.c.replay_cache.get(key)
+            if cached is not None and cached[0] == size:
+                plan.append(("block", self._event_block(
+                    cached[2], dead, filters, spec, require_target)))
+                continue
+            chunks = (_frame_chunks(seg, size, procs) if pool is not None
+                      else [(0, size)])
+            futs = [(pool.submit(scan_chunk, key, s, e, cfg_blob)
+                     if pool is not None else None, s, e)
+                    for s, e in chunks]
+            plan.append(("futs", futs, seg))
+        blocks: List[tuple] = []
+        for entry in plan:
+            if entry[0] == "block":
+                blocks.append(entry[1])
+                continue
+            _tag, futs, seg = entry
+            seg_blocks: List[tuple] = []
+            need_exact = truncated = False
+            for fut, s, e in futs:
+                if truncated:
+                    break
+                try:
+                    res = (fut.result() if fut is not None
+                           else scan_chunk(str(seg), s, e, cfg_blob))
+                except Exception:
+                    need_exact = True   # pool/worker failure: Event path
+                    break
+                if res[0] == "exact":
+                    need_exact = True
+                    break
+                _ok, block, consumed = res
+                seg_blocks.append(block)
+                if consumed < e:
+                    # CRC-invalid frame mid-journal: a serial scan stops
+                    # there, so later chunks must be dropped too
+                    truncated = True
+            if need_exact:
+                blocks.append(self._event_block(
+                    self._replay_segment(seg), dead, filters, spec,
+                    require_target))
+            else:
+                blocks.extend(seg_blocks)
+        return columns.merge_blocks(blocks)
+
+    def _event_block(self, table: Dict[str, Event], dead, filters,
+                     spec, require_target: bool) -> tuple:
+        """Event-object fallback block for one replayed segment."""
+        evs = [e for e in table.values()
+               if self._live(e, dead) and base.match_event(e, **filters)]
+        return columns.block_from_events(evs, spec, require_target)
+
+    # -- prepared-data cache support -----------------------------------------
+    def ingest_watermark(self, app_id: int,
+                         channel_id: Optional[int] = None) -> Dict[str, int]:
+        """Byte watermarks of every journal feeding a scan. Any append
+        grows a segment (or creates one), any delete grows
+        tombstones.log, external ids grow external_ids.log — so an
+        unchanged watermark proves an unchanged scan result."""
+        part = self._part_dir(app_id, channel_id)
+        wm: Dict[str, int] = {}
+        for seg in self._segments(part):
+            try:
+                wm[seg.name] = seg.stat().st_size
+            except OSError:
+                wm[seg.name] = -1
+        for name in ("tombstones.log", "external_ids.log"):
+            p = part / name
+            wm[name] = p.stat().st_size if p.exists() else 0
+        return wm
+
+    def ingest_cache_dir(self, app_id: int,
+                         channel_id: Optional[int] = None) -> Path:
+        return self._part_dir(app_id, channel_id) / "_prepared"
+
+    # -- columnar property aggregation ---------------------------------------
+    def aggregate_properties(self, app_id: int,
+                             channel_id: Optional[int] = None, *,
+                             entity_type: str,
+                             start_time=None, until_time=None,
+                             required=None):
+        """$set/$unset/$delete replay through the pushdown + raw-frame
+        scan: segments without property events prune via the name set,
+        and surviving frames fold into EventOps without constructing
+        Events (the base path decodes every frame into an Event plus
+        two datetimes first). Byte-equivalent to the base
+        implementation; journals the raw path can't reproduce exactly
+        fall back to it."""
+        from predictionio_tpu.data import aggregate as agg
+        names = ("$set", "$unset", "$delete")
+        name_set = frozenset(names)
+        part = self._part_dir(app_id, channel_id)
+        start_us = _us(start_time) if start_time is not None else None
+        until_us = _us(until_time) if until_time is not None else None
+        dead = self._tombstones(part)
+        rows: List[tuple] = []   # (tus, seq, name, entity_id, props|None)
+        seq = 0
+        for seg in self._segments(part):
+            if not self._segment_survives(
+                    self._index(seg), start_us=start_us, until_us=until_us,
+                    entity_type=entity_type, entity_id=None,
+                    event_names=names, target_entity_type=base._UNSET,
+                    target_entity_id=base._UNSET, properties=None):
+                self.c.stats["segments_pruned"] += 1
+                continue
+            self.c.stats["segments_scanned"] += 1
+            key = str(seg)
+            try:
+                size = seg.stat().st_size
+            except OSError:
+                continue
+            cached = self.c.replay_cache.get(key)
+            if cached is not None and cached[0] == size:
+                for e in cached[2].values():
+                    if e.event not in name_set \
+                            or e.entity_type != entity_type \
+                            or not self._live(e, dead) \
+                            or not base.match_event(
+                                e, start_time=start_time,
+                                until_time=until_time):
+                        continue
+                    rows.append((columns._event_us(e), seq, e.event,
+                                 e.entity_id, e.properties._fields))
+                    seq += 1
+                continue
+            for payload, _end in EventLog(key).scan_from(0):
+                obj = json.loads(payload.decode())
+                if "$tombstone" in obj or "tus" not in obj \
+                        or not _GEN_ID.match(obj["id"]):
+                    # dict-replay semantics needed: base path instead
+                    return super().aggregate_properties(
+                        app_id, channel_id, entity_type=entity_type,
+                        start_time=start_time, until_time=until_time,
+                        required=required)
+                if obj["e"] not in name_set or obj["et"] != entity_type:
+                    continue
+                tus = obj["tus"]
+                if start_us is not None and tus < start_us:
+                    continue
+                if until_us is not None and tus >= until_us:
+                    continue
+                if dead and dead.get(obj["id"], -1) >= obj["cus"]:
+                    continue
+                rows.append((tus, seq, obj["e"], obj["ei"], obj.get("p")))
+                seq += 1
+        rows.sort(key=lambda r: (r[0], r[1]))   # find()'s stable time sort
+        ops: Dict[str, agg.EventOp] = {}
+        for tus, _seq, name, ei, p in rows:
+            op = agg.op_from_parts(
+                name, p, columns.t_millis_from_us_scalar(tus))
+            prev = ops.get(ei)
+            ops[ei] = op if prev is None else prev.combine(op)
+        out = {}
+        for ei, op in ops.items():
+            pm = op.to_property_map()
+            if pm is not None:
+                out[ei] = pm
+        if required:
+            req = list(required)
+            out = {k: v for k, v in out.items()
+                   if all(r in v.fields for r in req)}
+        return out
+
+
+# -- ingest worker pool ------------------------------------------------------
+
+_CHUNK_MIN_BYTES = 1 << 20      # don't chunk journals under 1 MiB
+_DEAD_SHIP_MAX = 50_000         # tombstone-map size cap for worker cfg
+_SCAN_POOL = None
+_SCAN_POOL_PROCS = 0            # -1 = pools unusable in this process
+_SCAN_POOL_LOCK = threading.Lock()
+
+
+def ingest_workers(override: Optional[int] = None) -> int:
+    """Scan parallelism: explicit override, else PIO_INGEST_WORKERS,
+    else 1 (serial in-process decode)."""
+    if override is not None:
+        return max(1, int(override))
+    try:
+        return max(1, int(os.environ.get("PIO_INGEST_WORKERS", "1") or "1"))
+    except ValueError:
+        return 1
+
+
+def _scan_pool(procs: int):
+    """Persistent spawn-start worker pool. Spawn, not fork: the parent
+    may hold jax/XLA runtime threads that a fork would deadlock. The
+    ~0.5 s startup is paid once per process and amortized across every
+    scan. Returns None when pools can't start (sandboxes, missing
+    semaphores) — callers then decode inline."""
+    global _SCAN_POOL, _SCAN_POOL_PROCS
+    with _SCAN_POOL_LOCK:
+        if _SCAN_POOL_PROCS == -1:
+            return None
+        if _SCAN_POOL is not None and _SCAN_POOL_PROCS >= procs:
+            return _SCAN_POOL
+        try:
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+            pool = ProcessPoolExecutor(
+                max_workers=procs,
+                mp_context=multiprocessing.get_context("spawn"))
+            pool.submit(int, 0).result(timeout=120)   # fail fast, not mid-scan
+            if _SCAN_POOL is not None:
+                _SCAN_POOL.shutdown(wait=False)
+            _SCAN_POOL, _SCAN_POOL_PROCS = pool, procs
+            return pool
+        except Exception:
+            _SCAN_POOL_PROCS = -1
+            return None
+
+
+def _frame_chunks(path: Path, size: int, procs: int):
+    """Frame-aligned byte ranges for chunked decode. Header-only walk
+    (lengths, no CRC — workers verify payloads); stops at the first
+    torn header exactly where a serial scan would."""
+    target = max(size // max(procs, 1), _CHUNK_MIN_BYTES)
+    try:
+        with open(path, "rb") as f:
+            data = f.read(size)
+    except OSError:
+        return []
+    hsz = _HEADER.size
+    unpack = _HEADER.unpack_from
+    bounds = [0]
+    pos = 0
+    n = len(data)
+    while pos + hsz <= n:
+        magic, length, _crc = unpack(data, pos)
+        if magic != MAGIC or length > (1 << 30):
+            break
+        nxt = pos + hsz + length
+        if nxt > n:
+            break
+        pos = nxt
+        if pos - bounds[-1] >= target:
+            bounds.append(pos)
+    if pos > bounds[-1]:
+        bounds.append(pos)
+    return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]

@@ -44,6 +44,28 @@ def find_events(registry, app_name: str,
     return registry.get_events().find(app_id, channel_id, **filters)
 
 
+def rating_columns(registry, app_name: str,
+                   channel_name: Optional[str] = None, **kwargs):
+    """Columnar training read: `RatingColumns` built straight from the
+    journal via `store.scan_columns` (zero Event objects, worker-parallel,
+    prepared-data cached) — the fast replacement for
+    `RatingColumns.from_events(find_events(...))`. kwargs pass through to
+    `ingest.pipeline.rating_columns_from_store`."""
+    from predictionio_tpu.ingest.arrays import RatingColumns
+    app_id, channel_id = app_name_to_id(registry, app_name, channel_name)
+    return RatingColumns.from_store(
+        registry.get_events(), app_id, channel_id, **kwargs)
+
+
+def pair_columns(registry, app_name: str,
+                 channel_name: Optional[str] = None, **kwargs):
+    """Columnar `PairColumns` read; see `rating_columns`."""
+    from predictionio_tpu.ingest.arrays import PairColumns
+    app_id, channel_id = app_name_to_id(registry, app_name, channel_name)
+    return PairColumns.from_store(
+        registry.get_events(), app_id, channel_id, **kwargs)
+
+
 def aggregate_properties(registry, app_name: str, *, entity_type: str,
                          channel_name: Optional[str] = None,
                          **filters) -> Dict[str, PropertyMap]:

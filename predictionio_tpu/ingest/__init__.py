@@ -20,3 +20,8 @@ from predictionio_tpu.ingest.arrays import (  # noqa: F401
     LabeledPoints,
     labeled_points_from_properties,
 )
+from predictionio_tpu.ingest.pipeline import (  # noqa: F401
+    pair_columns_from_store,
+    rating_columns_from_store,
+    take_phase_timings,
+)

@@ -55,7 +55,14 @@ class _ColumnSet:
         """Pad every column to a common multiple of the mesh axis and
         device_put with dim-0 sharding. Padded tail rows carry neutral fill
         values (index 0, weight 0) so reductions can ignore them via the
-        implicit `weight/rating == 0` mask or the returned n_valid."""
+        implicit `weight/rating == 0` mask or the returned n_valid.
+
+        When the ingest pipeline already transferred these columns
+        (overlapped with the build stage), the pinned device copy is
+        returned instead of re-uploading."""
+        pre = getattr(self, "_presharded", None)
+        if pre is not None and pre[0] is mesh and pre[1] == axis:
+            return pre[2]
         cols = self._columns()
         out: Dict[str, object] = {}
         n = self.n
@@ -129,6 +136,17 @@ class RatingColumns(_ColumnSet):
                              rs.astype(np.float32), ts.astype(np.int64),
                              u_map, i_map)
 
+    @staticmethod
+    def from_store(store, app_id: int, channel_id=None,
+                   **kwargs) -> "RatingColumns":
+        """Columnar fast path: identical output to
+        `from_events(store.find(...))` but scanned straight into numpy
+        columns (no Event objects), worker-parallel and cached — see
+        `predictionio_tpu.ingest.pipeline.rating_columns_from_store`.
+        `value_spec` replaces the `rating_of` closure."""
+        from predictionio_tpu.ingest.pipeline import rating_columns_from_store
+        return rating_columns_from_store(store, app_id, channel_id, **kwargs)
+
 
 def default_rating_of(e: Event) -> Optional[float]:
     """'rate' events use their rating property; 'buy'/'view'/'like' style
@@ -177,6 +195,13 @@ class PairColumns(_ColumnSet):
             ws = np.zeros(0, np.float32)
         return PairColumns(li.astype(np.int32), ri.astype(np.int32),
                            ws.astype(np.float32), l_map, r_map)
+
+    @staticmethod
+    def from_store(store, app_id: int, channel_id=None,
+                   **kwargs) -> "PairColumns":
+        """Columnar fast path; see `RatingColumns.from_store`."""
+        from predictionio_tpu.ingest.pipeline import pair_columns_from_store
+        return pair_columns_from_store(store, app_id, channel_id, **kwargs)
 
 
 @dataclass

@@ -1,0 +1,417 @@
+"""Columnar training ingest: scan -> build -> (cache) -> overlapped H2D.
+
+The tf.data-style input pipeline over the event store (SURVEY.md §7):
+
+  1. scan    `store.scan_columns` decodes matching journal frames into
+             `EventColumns` with zero Event materialization, chunked
+             across the `PIO_INGEST_WORKERS` process pool.
+  2. build   numpy-vectorized finalization: fixed-BiMap remap,
+             last-wins dedup, epoch-ms conversion — no Python row loop.
+  3. cache   the finalized columns are persisted through the
+             checksummed blob envelope (`data.integrity`) keyed by the
+             full filter signature + the store's journal watermark, so
+             a retrain over an unchanged store skips the scan entirely;
+             any append/delete moves the watermark and invalidates.
+  4. transfer with a mesh, each finalized column is handed to a
+             one-slot transfer thread that runs `shard_put` while the
+             next column is still being built — H2D overlaps build, and
+             the device result rides along on the column struct so the
+             algorithm's later `.shard(mesh)` is free.
+
+Stage timings land in `pio_ingest_stage_seconds{stage=...}` and in a
+process-local accumulator the train workflow drains via
+`take_phase_timings()` into the `pio train` phase report.
+
+Cache knobs: `PIO_INGEST_CACHE=off` disables, `default`/unset uses the
+store's `ingest_cache_dir()` (pevlog: `<part_dir>/_prepared/`), any
+other value is an explicit cache directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime, timedelta, timezone
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data import integrity
+from predictionio_tpu.data.storage import base, columns as C
+from predictionio_tpu.ingest.arrays import PairColumns, RatingColumns, ShardedColumns
+from predictionio_tpu.ingest.bimap import BiMap
+from predictionio_tpu.obs import metrics as obs_metrics
+
+CACHE_FORMAT = 1
+_EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+_ONE_US = timedelta(microseconds=1)
+
+# train-scale stage durations, not request latencies
+_STAGE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                  2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+_timings_lock = threading.Lock()
+_timings: Dict[str, float] = {}
+
+_transfer_pool: Optional[ThreadPoolExecutor] = None
+_transfer_lock = threading.Lock()
+
+
+def take_phase_timings() -> Dict[str, float]:
+    """Drain accumulated ingest stage timings (seconds, plus cache hit
+    counts) for the train workflow's phase report. Keys ending in `_s`
+    become phases in `obs.report.record_train_phases`."""
+    with _timings_lock:
+        out = dict(_timings)
+        _timings.clear()
+    return out
+
+
+def _record_stage(stage: str, seconds: float) -> None:
+    reg = obs_metrics.get_registry()
+    reg.histogram("pio_ingest_stage_seconds",
+                  "Training ingest stage wall time",
+                  labels=("stage",),
+                  buckets=_STAGE_BUCKETS).labels(stage=stage).observe(seconds)
+    with _timings_lock:
+        key = f"ingest_{stage}_s"
+        _timings[key] = _timings.get(key, 0.0) + seconds
+
+
+def _record_cache(hit: bool) -> None:
+    reg = obs_metrics.get_registry()
+    name = ("pio_ingest_cache_hits_total" if hit
+            else "pio_ingest_cache_misses_total")
+    reg.counter(name, "Prepared-data cache lookups").inc()
+    with _timings_lock:
+        key = "ingest_cache_hits" if hit else "ingest_cache_misses"
+        _timings[key] = _timings.get(key, 0.0) + 1
+
+
+def _record_scan_rate(n_rows: int, seconds: float) -> None:
+    if seconds > 0:
+        obs_metrics.get_registry().gauge(
+            "pio_ingest_scan_events_per_s",
+            "Rows/s decoded by the last columnar scan").set(n_rows / seconds)
+
+
+# -- cache --------------------------------------------------------------------
+
+def _t_us(t: Optional[datetime]) -> Optional[int]:
+    if t is None:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return (t - _EPOCH) // _ONE_US
+
+
+def _cache_dir(store, app_id: int, channel_id: Optional[int],
+               cache) -> Optional[Path]:
+    """Resolve the cache directory, honoring `PIO_INGEST_CACHE`.
+    Returns None when caching is off or the store can't support it."""
+    if cache is False:
+        return None
+    mode = os.environ.get("PIO_INGEST_CACHE", "").strip()
+    if mode.lower() == "off":
+        return None
+    if store.ingest_watermark(app_id, channel_id) is None:
+        return None                      # driver has no watermark: no cache
+    if mode and mode.lower() != "default":
+        return Path(mode)
+    d = store.ingest_cache_dir(app_id, channel_id)
+    return Path(d) if d is not None else None
+
+
+def _encode_sig(v):
+    if isinstance(v, tuple):
+        return ["__t__", *[_encode_sig(x) for x in v]]
+    if isinstance(v, dict):
+        return {str(k): _encode_sig(x) for k, x in sorted(v.items())}
+    if isinstance(v, (list, frozenset, set)):
+        return [_encode_sig(x) for x in sorted(v, key=str)] \
+            if isinstance(v, (set, frozenset)) else [_encode_sig(x) for x in v]
+    return v
+
+
+def _cache_path(cache_dir: Path, sig: dict) -> Path:
+    blob = json.dumps(_encode_sig(sig), sort_keys=True,
+                      separators=(",", ":")).encode()
+    return cache_dir / (hashlib.sha256(blob).hexdigest() + ".pioc")
+
+
+def _cache_store(path: Path, watermark: Dict[str, int], kind: str,
+                 arrays: Dict[str, np.ndarray],
+                 tables: Dict[str, List[str]]) -> None:
+    header = {
+        "format": CACHE_FORMAT, "kind": kind, "watermark": watermark,
+        "tables": tables,
+        "arrays": [[name, a.dtype.str, int(a.shape[0])]
+                   for name, a in arrays.items()],
+    }
+    payload = json.dumps(header, separators=(",", ":")).encode() + b"\n" + \
+        b"".join(np.ascontiguousarray(a).tobytes() for a in arrays.values())
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        integrity.atomic_write_bytes(path, integrity.wrap(payload))
+    except OSError:
+        pass                             # cache write failure is non-fatal
+
+
+def _cache_load(path: Path, watermark: Dict[str, int], kind: str):
+    """-> (arrays dict, tables dict) on a fresh hit, else None. Any
+    corruption (torn blob, bad JSON, wrong shape) is a miss — the scan
+    path is always a safe fallback."""
+    try:
+        blob = path.read_bytes()
+    except OSError:
+        return None
+    try:
+        payload = integrity.unwrap(blob)
+        nl = payload.index(b"\n")
+        header = json.loads(payload[:nl].decode())
+        if header.get("format") != CACHE_FORMAT or header.get("kind") != kind:
+            return None
+        if header.get("watermark") != watermark:
+            return None                  # journal moved: stale
+        arrays: Dict[str, np.ndarray] = {}
+        off = nl + 1
+        for name, dtype, n in header["arrays"]:
+            dt = np.dtype(dtype)
+            end = off + dt.itemsize * n
+            a = np.frombuffer(payload[off:end], dtype=dt)
+            if a.shape[0] != n:
+                raise ValueError("truncated column")
+            arrays[name] = a
+            off = end
+        return arrays, header["tables"]
+    except (integrity.CorruptBlobError, ValueError, KeyError, TypeError):
+        return None
+
+
+# -- build helpers ------------------------------------------------------------
+
+def _translate(table: List[str], fixed: BiMap) -> np.ndarray:
+    """Scan-local intern table -> fixed BiMap ids (-1 = unseen: drop)."""
+    return np.array([fixed.get(k, -1) for k in table], np.int64) \
+        if table else np.zeros(0, np.int64)
+
+
+def _dedup_last_wins(u: np.ndarray, i: np.ndarray, r: np.ndarray,
+                     t: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """Vectorized replica of the `from_events` dict dedup: one row per
+    (u, i), positioned at the key's FIRST occurrence, carrying the
+    LAST occurrence's value (rows arrive time-sorted, so the last
+    occurrence is exactly the `t >= best` winner)."""
+    if u.size == 0:
+        return u, i, r, t
+    key = (u.astype(np.int64) << 32) | i.astype(np.int64)
+    _, first = np.unique(key, return_index=True)
+    _, rev_first = np.unique(key[::-1], return_index=True)
+    last = key.size - 1 - rev_first      # np.unique sorts keys: rows align
+    sel = last[np.argsort(first, kind="stable")]
+    return u[sel], i[sel], r[sel], t[sel]
+
+
+def _shard_overlapped(mesh, axis: str, fills: Dict[str, object],
+                      make_cols) -> Tuple[ShardedColumns, Dict[str, np.ndarray]]:
+    """Double-buffered H2D: `make_cols` yields (name, array) lazily; each
+    array goes to the one-slot transfer thread's `shard_put` while the
+    next column is still being materialized on the host."""
+    global _transfer_pool
+    from predictionio_tpu.parallel import shard_put
+    with _transfer_lock:
+        if _transfer_pool is None:
+            _transfer_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pio-ingest-h2d")
+    futs, host = [], {}
+    n = 0
+    for name, a in make_cols():
+        host[name] = a
+        n = int(a.shape[0])
+        futs.append((name, _transfer_pool.submit(
+            shard_put, a, mesh, axis, fill=fills.get(name, 0))))
+    arrays = {name: f.result()[0] for name, f in futs}
+    return ShardedColumns(arrays, n), host
+
+
+# -- public builders ----------------------------------------------------------
+
+def rating_columns_from_store(
+        store, app_id: int, channel_id: Optional[int] = None, *,
+        event_names: Optional[Sequence[str]] = None,
+        value_spec=None,
+        dedup_last_wins: bool = False,
+        users: Optional[BiMap] = None,
+        items: Optional[BiMap] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        workers: Optional[int] = None,
+        mesh=None, axis: str = "data",
+        cache: bool = True) -> RatingColumns:
+    """`RatingColumns.from_events(store.find(...))` semantics on the
+    columnar fast path — identical arrays and BiMaps, no Event objects.
+    `value_spec` replaces the `rating_of` closure (see
+    `data.storage.columns.normalize_value_spec`)."""
+    spec = C.normalize_value_spec(value_spec)
+    filters = dict(
+        start_time=start_time, until_time=until_time,
+        entity_type=entity_type, event_names=event_names,
+        target_entity_type=(base._UNSET if target_entity_type is None
+                            else target_entity_type))
+    sig = {
+        "kind": "rating", "app": app_id, "channel": channel_id,
+        "event_names": sorted(event_names) if event_names else None,
+        "entity_type": entity_type,
+        "target_entity_type": target_entity_type,
+        "start_us": _t_us(start_time), "until_us": _t_us(until_time),
+        "value_spec": spec, "dedup": bool(dedup_last_wins),
+        "fixed_users": users.keys() if users is not None else None,
+        "fixed_items": items.keys() if items is not None else None,
+    }
+    arrays, tables = _prepared(
+        store, app_id, channel_id, sig, "rating", filters, spec,
+        workers, cache,
+        lambda cols: _finalize_rating(cols, users, items, dedup_last_wins))
+    u_map = users if users is not None else _bimap(tables["users"])
+    i_map = items if items is not None else _bimap(tables["items"])
+    rc = RatingColumns(arrays["user_ix"], arrays["item_ix"],
+                       arrays["rating"], arrays["t_millis"], u_map, i_map)
+    if mesh is not None:
+        _attach_presharded(rc, mesh, axis)
+    return rc
+
+
+def pair_columns_from_store(
+        store, app_id: int, channel_id: Optional[int] = None, *,
+        event_names: Optional[Sequence[str]] = None,
+        value_spec=None,
+        left: Optional[BiMap] = None,
+        right: Optional[BiMap] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        workers: Optional[int] = None,
+        mesh=None, axis: str = "data",
+        cache: bool = True) -> PairColumns:
+    """`PairColumns.from_events(store.find(...))` on the columnar path;
+    `value_spec` replaces `weight_of` (default: every match weighs 1)."""
+    spec = C.normalize_value_spec(value_spec)
+    filters = dict(
+        start_time=start_time, until_time=until_time,
+        entity_type=entity_type, event_names=event_names,
+        target_entity_type=(base._UNSET if target_entity_type is None
+                            else target_entity_type))
+    sig = {
+        "kind": "pair", "app": app_id, "channel": channel_id,
+        "event_names": sorted(event_names) if event_names else None,
+        "entity_type": entity_type,
+        "target_entity_type": target_entity_type,
+        "start_us": _t_us(start_time), "until_us": _t_us(until_time),
+        "value_spec": spec,
+        "fixed_left": left.keys() if left is not None else None,
+        "fixed_right": right.keys() if right is not None else None,
+    }
+    arrays, tables = _prepared(
+        store, app_id, channel_id, sig, "pair", filters, spec,
+        workers, cache, lambda cols: _finalize_pair(cols, left, right))
+    l_map = left if left is not None else _bimap(tables["left"])
+    r_map = right if right is not None else _bimap(tables["right"])
+    pc = PairColumns(arrays["left_ix"], arrays["right_ix"],
+                     arrays["weight"], l_map, r_map)
+    if mesh is not None:
+        _attach_presharded(pc, mesh, axis)
+    return pc
+
+
+def _bimap(table: List[str]) -> BiMap:
+    # tables are already dense first-seen order: skip from_keys' dedup loop
+    return BiMap({k: ix for ix, k in enumerate(table)})
+
+
+def _prepared(store, app_id, channel_id, sig, kind, filters, spec,
+              workers, cache, finalize):
+    """scan -> finalize -> cache plumbing shared by both builders.
+    `finalize(EventColumns) -> (arrays dict, tables dict)`."""
+    cache_dir = _cache_dir(store, app_id, channel_id, cache)
+    path = watermark = None
+    if cache_dir is not None:
+        watermark = store.ingest_watermark(app_id, channel_id)
+        path = _cache_path(cache_dir, sig)
+        got = _cache_load(path, watermark, kind)
+        if got is not None:
+            _record_cache(True)
+            return got
+        _record_cache(False)
+    t0 = time.perf_counter()
+    cols = store.scan_columns(
+        app_id, channel_id, value_spec=spec, require_target=True,
+        workers=workers, **filters)
+    scan_s = time.perf_counter() - t0
+    _record_stage("scan", scan_s)
+    _record_scan_rate(cols.n, scan_s)
+    t0 = time.perf_counter()
+    arrays, tables = finalize(cols)
+    _record_stage("build", time.perf_counter() - t0)
+    if path is not None:
+        _cache_store(path, watermark, kind, arrays, tables)
+    return arrays, tables
+
+
+def _finalize_rating(cols: C.EventColumns, users: Optional[BiMap],
+                     items: Optional[BiMap], dedup: bool):
+    u, i = cols.entity_ix.astype(np.int64), cols.target_ix.astype(np.int64)
+    r, t = cols.value, cols.t_millis
+    if users is not None or items is not None:
+        tu = _translate(cols.entities, users) if users is not None else None
+        ti = _translate(cols.targets, items) if items is not None else None
+        u = tu[u] if tu is not None and u.size else u
+        i = ti[i] if ti is not None and i.size else i
+        keep = (u >= 0) & (i >= 0)
+        u, i, r, t = u[keep], i[keep], r[keep], t[keep]
+    if dedup:
+        u, i, r, t = _dedup_last_wins(u, i, r, t)
+    arrays = {"user_ix": u.astype(np.int32), "item_ix": i.astype(np.int32),
+              "rating": r.astype(np.float32), "t_millis": t.astype(np.int64)}
+    tables = {"users": cols.entities, "items": cols.targets}
+    return arrays, tables
+
+
+def _finalize_pair(cols: C.EventColumns, left: Optional[BiMap],
+                   right: Optional[BiMap]):
+    l, r = cols.entity_ix.astype(np.int64), cols.target_ix.astype(np.int64)
+    w = cols.value
+    if left is not None or right is not None:
+        tl = _translate(cols.entities, left) if left is not None else None
+        tr = _translate(cols.targets, right) if right is not None else None
+        l = tl[l] if tl is not None and l.size else l
+        r = tr[r] if tr is not None and r.size else r
+        keep = (l >= 0) & (r >= 0)
+        l, r, w = l[keep], r[keep], w[keep]
+    arrays = {"left_ix": l.astype(np.int32), "right_ix": r.astype(np.int32),
+              "weight": w.astype(np.float32)}
+    tables = {"left": cols.entities, "right": cols.targets}
+    return arrays, tables
+
+
+def _attach_presharded(colset, mesh, axis: str) -> None:
+    """Run the H2D transfer now, column by column on the one-slot
+    transfer thread, and pin the result so `colset.shard(mesh)` is a
+    cache hit inside the algorithm."""
+    t0 = time.perf_counter()
+    cols = colset._columns()
+
+    def gen():
+        for name, a in cols.items():
+            yield name, a
+
+    sharded, _ = _shard_overlapped(mesh, axis, dict(colset._FILL), gen)
+    colset._presharded = (mesh, axis, sharded)
+    _record_stage("transfer", time.perf_counter() - t0)

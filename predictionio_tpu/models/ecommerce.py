@@ -75,15 +75,13 @@ class ECommDataSource(DataSource):
 
     def read_training(self, ctx: RuntimeContext) -> TrainingData:
         p = self.params
-        views = RatingColumns.from_events(
-            store.find_events(ctx.registry, p.app_name, p.channel,
-                              event_names=["view"]),
-            rating_of=lambda e: 1.0)
+        views = store.rating_columns(
+            ctx.registry, p.app_name, p.channel,
+            event_names=["view"], value_spec={"*": 1.0})
         # buys share the view BiMaps so popularity aligns with factors
-        buys = RatingColumns.from_events(
-            store.find_events(ctx.registry, p.app_name, p.channel,
-                              event_names=["buy"]),
-            rating_of=lambda e: 1.0,
+        buys = store.rating_columns(
+            ctx.registry, p.app_name, p.channel,
+            event_names=["buy"], value_spec={"*": 1.0},
             users=views.users, items=views.items)
         cats: Dict[str, List[str]] = {}
         props = store.aggregate_properties(

@@ -83,20 +83,15 @@ class RecommendationDataSource(DataSource):
 
     def _ratings(self, ctx: RuntimeContext) -> RatingColumns:
         p = self.params
-
-        def rating_of(e):
-            if e.event == "rate":
-                v = e.properties.get_opt("rating")
-                return float(v) if v is not None else None
-            if e.event == "buy":
-                return p.buy_rating   # buy counts as rating 4 (DataSource.scala:61-66)
-            return None
-
-        events = store.find_events(
+        # columnar ingest path — same output as the Event iterator with
+        # rating_of {rate -> properties.rating, buy -> buy_rating}
+        # (DataSource.scala:61-66), but scanned without Event objects
+        return store.rating_columns(
             ctx.registry, p.app_name, p.channel,
-            event_names=["rate", "buy"])
-        return RatingColumns.from_events(events, rating_of=rating_of,
-                                         dedup_last_wins=True)
+            event_names=["rate", "buy"],
+            value_spec={"rate": ("prop", "rating"),
+                        "buy": float(p.buy_rating)},
+            dedup_last_wins=True)
 
     def read_training(self, ctx: RuntimeContext) -> RatingColumns:
         return self._ratings(ctx)

@@ -48,10 +48,9 @@ class SeqRecDataSource(DataSource):
 
     def read_training(self, ctx: RuntimeContext) -> RatingColumns:
         p = self.params
-        return RatingColumns.from_events(
-            store.find_events(ctx.registry, p.app_name, p.channel,
-                              event_names=list(p.event_names)),
-            rating_of=lambda e: 1.0)
+        return store.rating_columns(
+            ctx.registry, p.app_name, p.channel,
+            event_names=list(p.event_names), value_spec={"*": 1.0})
 
 
 @dataclass

@@ -76,14 +76,13 @@ class SimilarProductDataSource(DataSource):
 
     def read_training(self, ctx: RuntimeContext) -> TrainingData:
         p = self.params
-        views = RatingColumns.from_events(
-            store.find_events(ctx.registry, p.app_name, p.channel,
-                              event_names=["view"]),
-            rating_of=lambda e: 1.0)
-        likes = RatingColumns.from_events(
-            store.find_events(ctx.registry, p.app_name, p.channel,
-                              event_names=["like", "dislike"]),
-            rating_of=lambda e: 1.0 if e.event == "like" else -1.0,
+        views = store.rating_columns(
+            ctx.registry, p.app_name, p.channel,
+            event_names=["view"], value_spec={"*": 1.0})
+        likes = store.rating_columns(
+            ctx.registry, p.app_name, p.channel,
+            event_names=["like", "dislike"],
+            value_spec={"like": 1.0, "dislike": -1.0},
             dedup_last_wins=True)   # latest like/dislike wins (template doc)
         cats: Dict[str, List[str]] = {}
         props = store.aggregate_properties(
@@ -129,6 +128,15 @@ class _FactorSimilarityAlgorithm(Algorithm):
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
+    def warm_serving(self, model: SimilarModel, buckets) -> int:
+        """Deploy warmup: pin item factors device-resident and
+        AOT-compile the per-bucket cosine-top-k executables, so the
+        dense-mask serve path never consults the jit tracing cache."""
+        from predictionio_tpu.ops.topk import BucketedSimilar
+        self._serve_plan = BucketedSimilar(
+            model.item_factors, k=Query().num, buckets=buckets)
+        return self._serve_plan.warm()
+
     def batch_predict(self, model: SimilarModel,
                       queries: Sequence[Tuple[int, Query]]
                       ) -> List[Tuple[int, PredictedResult]]:
@@ -150,8 +158,12 @@ class _FactorSimilarityAlgorithm(Algorithm):
         mask = np.concatenate(
             [_resolve_filters(model.items, model.item_categories, q)
              for _, q, _ in live], axis=0)
-        scores, ixs = topk_similar(vecs.astype(np.float32),
-                                   model.item_factors, mask, k=k)
+        plan = getattr(self, "_serve_plan", None)
+        if plan is not None and plan.fits(k=k):
+            scores, ixs = plan(vecs.astype(np.float32), mask)
+        else:
+            scores, ixs = topk_similar(vecs.astype(np.float32),
+                                       model.item_factors, mask, k=k)
         scores, ixs = np.asarray(scores), np.asarray(ixs)
         for row, (i, q, _) in enumerate(live):
             items = [ItemScore(model.items.inverse(int(ix)), float(s))

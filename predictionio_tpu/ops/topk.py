@@ -160,6 +160,19 @@ class DispatchPolicy:
                     "device_call_s": self._device_call_s,
                     "host_inflight": self._host_inflight}
 
+    def restore(self, state: dict) -> None:
+        """Re-seed the EWMAs from a persisted `snapshot()` so a server
+        restart/reload starts from the learned host/device crossover
+        instead of the cold one-shot rule. The in-flight count is
+        transient and never restored; junk fields are ignored."""
+        with self._lock:
+            h = state.get("host_s_per_cell")
+            d = state.get("device_call_s")
+            if isinstance(h, (int, float)) and h > 0:
+                self._host_s_per_cell = float(h)   # lint: ok — host JSON
+            if isinstance(d, (int, float)) and d > 0:
+                self._device_call_s = float(d)     # lint: ok — host JSON
+
 
 DISPATCH_POLICY = DispatchPolicy()
 
@@ -185,8 +198,7 @@ def _topk_scores_device(user_vecs, item_factors, mask, *, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _topk_similar_device(query_vecs, item_factors, mask, *, k: int):
+def _topk_similar_raw(query_vecs, item_factors, mask, *, k: int):
     qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1, keepdims=True)
                        + 1e-9)
     fn = item_factors / (jnp.linalg.norm(item_factors, axis=-1, keepdims=True)
@@ -194,6 +206,16 @@ def _topk_similar_device(query_vecs, item_factors, mask, *, k: int):
     scores = jnp.matmul(qn, fn.T, precision=jax.lax.Precision.HIGHEST)
     scores = jnp.where(mask, scores, NEG_INF)
     return jax.lax.top_k(scores, k)
+
+
+_topk_similar_device = partial(
+    jax.jit, static_argnames=("k",))(_topk_similar_raw)
+
+# AOT serving-plan variant (BucketedSimilar): donates the per-call query
+# block and dense mask off-CPU, mirroring _topk_scores_banned_donated
+_topk_similar_donated = partial(
+    jax.jit, static_argnames=("k",),
+    donate_argnums=(0, 2))(_topk_similar_raw)
 
 
 def _is_traced(*arrays) -> bool:
@@ -535,6 +557,89 @@ class BucketedTopK:
             if len(bl):
                 banned[row, :len(bl)] = np.asarray(bl, np.int32)  # lint: ok
         scores, ixs = jax.device_get(exe(vecs, self.factors, banned))
+        _record_dispatch("device", bucket * self.n_items,
+                         time.perf_counter() - t0)
+        return scores[:b], ixs[:b]
+
+
+class BucketedSimilar:
+    """Serving plan for the dense-mask cosine path (the similar-product
+    template's `batch_predict`): item factors pinned device-resident and
+    one AOT-compiled `_topk_similar_raw` executable per batch bucket, so
+    a warmed deployment serves its first similar-items request — and
+    every coalesced batch after it — without touching the jit tracing
+    cache.
+
+    Unlike `BucketedTopK` the filter here is the template's dense
+    [b, n_items] category/white/black mask, so the mask block is padded
+    to the bucket with all-False rows (their lanes score NEG_INF and are
+    sliced off before return). Batches above the biggest bucket chunk.
+    """
+
+    def __init__(self, item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS):
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        self.n_items, self.rank = host.shape
+        self.k = max(1, min(k, self.n_items))
+        self.buckets = tuple(sorted({_next_pow2(b)
+                                     for b in buckets if b > 0})) or (1,)
+        self._host_factors = host
+        self.factors = device_resident(host)
+        self._exe: dict = {}
+
+    def warm(self) -> int:
+        """AOT-lower/compile every bucket executable (idempotent)."""
+        fn = (_topk_similar_device if jax.default_backend() == "cpu"
+              else _topk_similar_donated)
+        compiled = 0
+        for b in self.buckets:
+            if b in self._exe:
+                continue
+            vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
+            mask_spec = jax.ShapeDtypeStruct((b, self.n_items), np.bool_)
+            self._exe[b] = fn.lower(vec_spec, self.factors, mask_spec,
+                                    k=self.k).compile()
+            compiled += 1
+        return compiled
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def fits(self, *, k: int) -> bool:
+        return bool(self._exe) and k <= self.k
+
+    def _bucket_for(self, b: int) -> int:
+        for bucket in self.buckets:
+            if bucket >= b:
+                return bucket
+        return self.max_bucket
+
+    def __call__(self, query_vecs, mask):
+        """Cosine top-k of `query_vecs` [b, rank] against the resident
+        factors under dense mask [b, n_items]; returns host (scores
+        [b, k], indexes [b, k])."""
+        query_vecs = np.asarray(query_vecs, np.float32)  # lint: ok — host in
+        mask = np.asarray(mask, bool)                    # lint: ok — host in
+        b = query_vecs.shape[0]
+        if b > self.max_bucket:
+            parts = [self(query_vecs[lo:lo + self.max_bucket],
+                          mask[lo:lo + self.max_bucket])
+                     for lo in range(0, b, self.max_bucket)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        bucket = self._bucket_for(b)
+        exe = self._exe.get(bucket)
+        if exe is None:
+            raise RuntimeError(
+                f"BucketedSimilar bucket {bucket} not warmed; call warm() "
+                "at deploy time")
+        t0 = time.perf_counter()
+        vecs = np.zeros((bucket, self.rank), np.float32)
+        vecs[:b] = query_vecs
+        mask_p = np.zeros((bucket, self.n_items), bool)
+        mask_p[:b] = mask
+        scores, ixs = jax.device_get(exe(vecs, self.factors, mask_p))
         _record_dispatch("device", bucket * self.n_items,
                          time.perf_counter() - t0)
         return scores[:b], ixs[:b]

@@ -426,6 +426,9 @@ class PredictionServer(HTTPServerBase):
         # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables)
         from predictionio_tpu.data.fsck import startup_check
         startup_check(self.ctx.registry, log=_log.warning)
+        # warm-start the topk dispatch policy from the last run's learned
+        # host/device crossover before any serve traffic arrives
+        self._restore_dispatch_state()
         self._load(instance)
         self._routes()
 
@@ -464,6 +467,48 @@ class PredictionServer(HTTPServerBase):
             self._dep = _Deployment(engine, instance, algos, models,
                                     serving, obs=self._serve_obs)
         self._serve_obs.reloads.labels(outcome="ok").inc()
+        # checkpoint the learned dispatch EWMAs on every successful
+        # (re)load, so the NEXT process start resumes warm
+        self._save_dispatch_state()
+
+    # -- dispatch-policy persistence ----------------------------------------
+    @staticmethod
+    def _dispatch_state_path():
+        """Where the serve DispatchPolicy EWMA snapshot lives.
+        `PIO_DISPATCH_STATE=off` disables persistence; any other value
+        overrides the default `~/.pio_store/serving/` location."""
+        import os
+        from pathlib import Path
+        p = os.environ.get("PIO_DISPATCH_STATE", "").strip()
+        if p.lower() == "off":
+            return None
+        if p:
+            return Path(p).expanduser()
+        return Path("~/.pio_store/serving/dispatch_policy.json").expanduser()
+
+    def _restore_dispatch_state(self) -> None:
+        path = self._dispatch_state_path()
+        if path is None:
+            return
+        from predictionio_tpu.ops.topk import DISPATCH_POLICY
+        try:
+            state = json.loads(path.read_text())
+        except (OSError, ValueError):
+            return                       # absent/corrupt: cold start
+        if isinstance(state, dict):
+            DISPATCH_POLICY.restore(state)
+
+    def _save_dispatch_state(self) -> None:
+        path = self._dispatch_state_path()
+        if path is None:
+            return
+        from predictionio_tpu.data.integrity import atomic_write_text
+        from predictionio_tpu.ops.topk import DISPATCH_POLICY
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(path, json.dumps(DISPATCH_POLICY.snapshot()))
+        except OSError:
+            pass                         # persistence is best-effort
 
     def readiness(self):
         """/ready: a model must be loaded and no storage breaker OPEN."""

@@ -70,6 +70,9 @@ _RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/")
 _DEVICE_HOT_PATHS = ("predictionio_tpu/ops/topk.py",
                      "predictionio_tpu/serving/")
 
+# template data sources: training reads must use the columnar scan
+_MODELS_DIRS = ("predictionio_tpu/models/",)
+
 
 def _used_names(tree: ast.AST) -> set:
     used = set()
@@ -309,6 +312,41 @@ def _check_device_transfers(tree: ast.AST, text: str,
                    "host values")
 
 
+def _check_training_reads(tree: ast.AST, text: str,
+                          rel: str) -> Iterator[str]:
+    """In models/: a ``read_training`` that iterates Events via
+    ``store.find_events(`` walks the slow object path — per-frame
+    Event + datetime + DataMap construction — instead of the columnar
+    ingest pipeline (``store.rating_columns`` / ``store.pair_columns``
+    or ``EventStore.scan_columns``), which is several times faster and
+    prepared-data cached. Serving-time reads (``find_by_entity``) and
+    property aggregation are fine. ``# lint: ok`` on the line is the
+    escape hatch for genuinely event-shaped training data."""
+    if not rel.startswith(_MODELS_DIRS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or node.name != "read_training":
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr == "find_events"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "store"):
+                continue
+            line = lines[sub.lineno - 1] if sub.lineno <= len(lines) else ""
+            if "# lint: ok" in line:
+                continue
+            yield (f"{rel}:{sub.lineno}: store.find_events() in "
+                   "read_training materializes Events on the training "
+                   "path; use the columnar store.rating_columns/"
+                   "pair_columns (or mark '# lint: ok')")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -329,6 +367,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_bounded_waits(tree, text, rel))
     out.extend(_check_storage_writes(tree, text, rel))
     out.extend(_check_device_transfers(tree, text, rel))
+    out.extend(_check_training_reads(tree, text, rel))
     return out
 
 

@@ -1,0 +1,252 @@
+"""Columnar training ingest pipeline (the ingest PR's tentpole): the
+scan must be byte-equivalent to the Event-materializing oracle —
+identical arrays AND identical BiMaps — on every filter combination,
+deterministic across worker counts, and the prepared-data cache must
+skip the segment scan on an unchanged store, invalidate on any
+append/delete, and fall back to a full scan on a torn blob."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.pevlog import (
+    PevlogEvents, PevlogStorageClient,
+)
+from predictionio_tpu.ingest.arrays import PairColumns, RatingColumns
+from predictionio_tpu.ingest.pipeline import (
+    pair_columns_from_store, rating_columns_from_store, take_phase_timings,
+)
+from predictionio_tpu.obs import metrics as obs_metrics
+
+T0 = datetime(2022, 3, 1, tzinfo=timezone.utc)
+
+VALUE_SPEC = {"rate": ("prop", "rating"), "buy": 4.0, "*": 1.0}
+
+
+def _rating_of(e):
+    """The Event-path closure VALUE_SPEC replaces."""
+    if e.event == "rate":
+        v = e.properties.get_opt("rating")
+        return float(v) if v is not None else None
+    if e.event == "buy":
+        return 4.0
+    return 1.0
+
+
+@pytest.fixture
+def store(tmp_path):
+    ev = PevlogEvents(PevlogStorageClient(
+        {"PATH": str(tmp_path), "BUCKET_HOURS": 24}))
+    ev.init(1)
+    return ev
+
+
+def _seed(store, n_days=6, per_day=40):
+    rng = np.random.RandomState(3)
+    evs = []
+    k = 0
+    for d in range(n_days):
+        for _ in range(per_day):
+            name = ("rate", "buy", "view")[k % 3]
+            props = {"rating": float(1 + k % 5)} if name == "rate" else {}
+            evs.append(Event(
+                event=name, entity_type="user",
+                entity_id=f"u{rng.randint(12)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.randint(9)}",
+                properties=DataMap(props),
+                event_time=T0 + timedelta(days=d, seconds=k)))
+            k += 1
+    store.insert_batch(evs, 1)
+
+
+def _assert_rc_equal(a: RatingColumns, b: RatingColumns):
+    assert a.users == b.users
+    assert a.items == b.items
+    np.testing.assert_array_equal(a.user_ix, b.user_ix)
+    np.testing.assert_array_equal(a.item_ix, b.item_ix)
+    np.testing.assert_array_equal(a.rating, b.rating)
+    np.testing.assert_array_equal(a.t_millis, b.t_millis)
+
+
+FILTERS = [
+    {},
+    {"event_names": ["rate", "buy"]},
+    {"start_time": T0 + timedelta(days=2),
+     "until_time": T0 + timedelta(days=5)},
+    {"event_names": ["view"], "start_time": T0 + timedelta(days=1)},
+    {"entity_type": "user", "target_entity_type": "item"},
+]
+
+
+class TestOracleEquivalence:
+    @pytest.mark.parametrize("filt", FILTERS,
+                             ids=[str(sorted(f)) for f in FILTERS])
+    @pytest.mark.parametrize("dedup", [False, True])
+    def test_matches_event_path(self, store, filt, dedup):
+        _seed(store)
+        oracle = RatingColumns.from_events(
+            store.find(1, **filt), rating_of=_rating_of,
+            dedup_last_wins=dedup)
+        assert oracle.n > 0
+        cols = rating_columns_from_store(
+            store, 1, value_spec=VALUE_SPEC, dedup_last_wins=dedup,
+            cache=False, **filt)
+        _assert_rc_equal(cols, oracle)
+
+    def test_fixed_bimaps_drop_unseen(self, store):
+        # buys remapped through the views' BiMaps — the e-commerce
+        # template's shape; rows unseen under the fixed maps drop
+        _seed(store)
+        views_o = RatingColumns.from_events(
+            store.find(1, event_names=["view"]), rating_of=lambda e: 1.0)
+        views_c = rating_columns_from_store(
+            store, 1, event_names=["view"], value_spec={"*": 1.0},
+            cache=False)
+        _assert_rc_equal(views_c, views_o)
+        buys_o = RatingColumns.from_events(
+            store.find(1, event_names=["buy"]), rating_of=lambda e: 1.0,
+            users=views_o.users, items=views_o.items)
+        buys_c = rating_columns_from_store(
+            store, 1, event_names=["buy"], value_spec={"*": 1.0},
+            users=views_c.users, items=views_c.items, cache=False)
+        _assert_rc_equal(buys_c, buys_o)
+
+    def test_pair_columns_match(self, store):
+        _seed(store)
+        oracle = PairColumns.from_events(store.find(1, event_names=["view"]))
+        cols = pair_columns_from_store(
+            store, 1, event_names=["view"], cache=False)
+        assert cols.left == oracle.left
+        assert cols.right == oracle.right
+        np.testing.assert_array_equal(cols.left_ix, oracle.left_ix)
+        np.testing.assert_array_equal(cols.right_ix, oracle.right_ix)
+        np.testing.assert_array_equal(cols.weight, oracle.weight)
+
+    def test_value_none_rows_drop_before_bimap_build(self, store):
+        # a rate event with no rating property contributes NOTHING —
+        # not even its entity ids — matching from_events row dropping
+        store.insert(Event(
+            event="rate", entity_type="user", entity_id="ghost-user",
+            target_entity_type="item", target_entity_id="ghost-item",
+            properties=DataMap({}), event_time=T0), 1)
+        _seed(store)
+        cols = rating_columns_from_store(
+            store, 1, event_names=["rate"],
+            value_spec={"rate": ("prop", "rating")}, cache=False)
+        assert "ghost-user" not in cols.users.keys()
+        assert "ghost-item" not in cols.items.keys()
+
+
+class TestWorkerDeterminism:
+    def test_identical_across_worker_counts(self, store):
+        _seed(store, n_days=4, per_day=120)
+        base = rating_columns_from_store(
+            store, 1, value_spec=VALUE_SPEC, dedup_last_wins=True,
+            workers=1, cache=False)
+        for w in (2, 4):
+            other = rating_columns_from_store(
+                store, 1, value_spec=VALUE_SPEC, dedup_last_wins=True,
+                workers=w, cache=False)
+            _assert_rc_equal(other, base)
+
+
+class TestPreparedCache:
+    def _read(self, store, **kw):
+        return rating_columns_from_store(
+            store, 1, value_spec=VALUE_SPEC, dedup_last_wins=True, **kw)
+
+    def test_hit_skips_segment_scan(self, store):
+        _seed(store)
+        reg = obs_metrics.get_registry()
+        hits0 = reg.value("pio_ingest_cache_hits_total") or 0.0
+        take_phase_timings()
+        first = self._read(store)
+        t1 = take_phase_timings()
+        assert t1.get("ingest_cache_misses") == 1
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        second = self._read(store)
+        t2 = take_phase_timings()
+        assert t2.get("ingest_cache_hits") == 1
+        assert store.c.stats["segments_scanned"] == 0
+        assert (reg.value("pio_ingest_cache_hits_total") or 0.0) > hits0
+        _assert_rc_equal(second, first)
+
+    def test_different_filters_do_not_share_entries(self, store):
+        _seed(store)
+        self._read(store)
+        take_phase_timings()
+        narrowed = self._read(store, event_names=["rate"])
+        assert take_phase_timings().get("ingest_cache_misses") == 1
+        oracle = RatingColumns.from_events(
+            store.find(1, event_names=["rate"]), rating_of=_rating_of,
+            dedup_last_wins=True)
+        _assert_rc_equal(narrowed, oracle)
+
+    def test_append_invalidates(self, store):
+        _seed(store)
+        first = self._read(store)
+        store.insert(Event(
+            event="buy", entity_type="user", entity_id="late-u",
+            target_entity_type="item", target_entity_id="late-i",
+            properties=DataMap({}),
+            event_time=T0 + timedelta(days=30)), 1)
+        take_phase_timings()
+        second = self._read(store)
+        assert take_phase_timings().get("ingest_cache_misses") == 1
+        assert second.n == first.n + 1
+        assert "late-u" in second.users.keys()
+
+    def test_delete_invalidates(self, store):
+        _seed(store)
+        self._read(store)    # populate the cache
+        victim = next(iter(store.find(1, event_names=["view"], limit=1)))
+        assert store.delete(victim.event_id, 1)
+        take_phase_timings()
+        second = self._read(store)
+        # the tombstone moved the watermark: miss, then a rescan whose
+        # output matches the post-delete Event-path oracle exactly
+        assert take_phase_timings().get("ingest_cache_misses") == 1
+        oracle = RatingColumns.from_events(
+            store.find(1), rating_of=_rating_of, dedup_last_wins=True)
+        _assert_rc_equal(second, oracle)
+        raw = rating_columns_from_store(
+            store, 1, event_names=["view"], value_spec={"*": 1.0},
+            cache=False)
+        assert victim.event_id is not None
+        assert raw.n == sum(1 for _ in store.find(1, event_names=["view"]))
+
+    def test_torn_blob_falls_back_to_full_scan(self, store):
+        _seed(store)
+        first = self._read(store)
+        blobs = list(store.ingest_cache_dir(1).glob("*.pioc"))
+        assert blobs
+        for b in blobs:
+            b.write_bytes(b.read_bytes()[:40])
+        store.c.stats.update(segments_pruned=0, segments_scanned=0)
+        take_phase_timings()
+        second = self._read(store)
+        assert take_phase_timings().get("ingest_cache_misses") == 1
+        assert store.c.stats["segments_scanned"] > 0
+        _assert_rc_equal(second, first)
+
+    def test_env_off_disables_cache(self, store, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_CACHE", "off")
+        _seed(store)
+        take_phase_timings()
+        self._read(store)
+        self._read(store)
+        t = take_phase_timings()
+        assert "ingest_cache_hits" not in t
+        assert "ingest_cache_misses" not in t
+        assert not list(store.ingest_cache_dir(1).glob("*.pioc"))
+
+    def test_env_redirects_cache_dir(self, store, tmp_path, monkeypatch):
+        alt = tmp_path / "alt-cache"
+        monkeypatch.setenv("PIO_INGEST_CACHE", str(alt))
+        _seed(store)
+        self._read(store)
+        assert list(alt.glob("*.pioc"))
+        assert not list(store.ingest_cache_dir(1).glob("*.pioc"))

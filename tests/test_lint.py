@@ -205,3 +205,44 @@ def test_device_transfer_gate_scoped_to_hot_paths(tmp_path):
         "    return float(np.asarray(scores)[0])\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_training_read_gate_catches_find_events_in_read_training(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "models" / "tmpl.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.data import store\n"
+        "def read_training(ctx):\n"
+        "    return list(store.find_events(ctx.registry, 'app'))\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "store.find_events() in read_training" in kinds
+    assert "rating_columns" in kinds
+
+
+def test_training_read_gate_line_escape_and_other_functions(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "models" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.data import store\n"
+        "def read_training(ctx):\n"
+        "    return list(store.find_events(ctx.registry, 'a'))  # lint: ok\n"
+        "def history(ctx):\n"   # serve-time reads are fine
+        "    return list(store.find_events(ctx.registry, 'a'))\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_training_read_gate_scoped_to_models(tmp_path):
+    # outside models/ a read_training helper may stream Events
+    ok = tmp_path / "predictionio_tpu" / "core" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.data import store\n"
+        "def read_training(ctx):\n"
+        "    return list(store.find_events(ctx.registry, 'a'))\n"
+    )
+    assert not lint.run(tmp_path)
