@@ -106,6 +106,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--event-server-port", type=int, default=7070)
     x.add_argument("--accesskey")
     x.add_argument("--batch-window-ms", type=int, default=0)
+    x.add_argument("--replicas", type=int, default=1,
+                   help="serve replicas behind the fleet control plane "
+                        "(>1 enables health-gated routing + rolling "
+                        "/reload)")
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
@@ -258,7 +262,7 @@ def main(argv: Optional[list] = None) -> int:
             return 0
         if cmd == "deploy":
             from predictionio_tpu.serving import (
-                PredictionServer, ServerConfig,
+                FleetConfig, FleetServer, PredictionServer, ServerConfig,
             )
             variant = ops.load_variant(args.engine_json)
             factory = ops.resolve_factory_name(variant, args.engine_factory,
@@ -273,9 +277,18 @@ def main(argv: Optional[list] = None) -> int:
                 access_key=args.accesskey,
                 batch_window_ms=args.batch_window_ms,
                 server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
-            server = PredictionServer(config, registry=registry)
-            port = server.start()
-            print(f"Engine server started on {args.ip}:{port}", flush=True)
+            if args.replicas > 1:
+                server = FleetServer(
+                    config, FleetConfig(replicas=args.replicas),
+                    registry=registry)
+                port = server.start()
+                print(f"Fleet control plane started on {args.ip}:{port} "
+                      f"({args.replicas} replicas)", flush=True)
+            else:
+                server = PredictionServer(config, registry=registry)
+                port = server.start()
+                print(f"Engine server started on {args.ip}:{port}",
+                      flush=True)
             _serve_forever(server)
             return 0
         if cmd == "undeploy":

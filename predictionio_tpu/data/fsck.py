@@ -21,11 +21,14 @@ verify()/repair() DAO contract); each finding dict carries at least
 
 Knobs: `PIO_FSCK_ON_STARTUP` (default on; report-only),
 `PIO_JANITOR` (default on at startup), `PIO_JANITOR_STALE_S`
-(default 900s).
+(default 900s), `PIO_FSCK_INTERVAL_S` (default off; scheduled
+background pass), `PIO_QUARANTINE_RETENTION_S` (default 7 days;
+quarantined blobs older than this are GC'd by the scheduled pass).
 """
 
 from __future__ import annotations
 
+import threading
 from datetime import timedelta
 from typing import Dict, List, Optional
 
@@ -36,6 +39,7 @@ from predictionio_tpu.data.storage.base import (
 from predictionio_tpu.obs import get_registry
 
 DEFAULT_STALE_S = 900.0
+DEFAULT_RETENTION_S = 7 * 24 * 3600.0
 
 
 def _metrics():
@@ -55,6 +59,15 @@ def _metrics():
         "janitor": reg.counter(
             "pio_janitor_failed_total",
             "Stale INIT/TRAINING instances transitioned to FAILED"),
+        "last_run": reg.gauge(
+            "pio_fsck_last_run_ts",
+            "Unix timestamp of the last completed fsck pass"),
+        "qbytes": reg.gauge(
+            "pio_quarantine_bytes",
+            "Bytes currently held in model-blob quarantine"),
+        "qcount": reg.gauge(
+            "pio_quarantine_count",
+            "Blobs currently held in model-blob quarantine"),
     }
 
 
@@ -87,6 +100,8 @@ def fsck_registry(registry, repair: bool = False) -> List[dict]:
         except (StorageError, OSError) as exc:
             found = [{"kind": "fsck_error", "repo": repo,
                       "reason": str(exc), "action": "none"}]
+        if repo == "models":
+            found.extend(_check_divergence(registry, dao, repair))
         for f in found:
             f.setdefault("repo", repo)
             m["findings"].labels(kind=f.get("kind", "unknown")).inc()
@@ -96,6 +111,64 @@ def fsck_registry(registry, repair: bool = False) -> List[dict]:
             if f.get("kind") == "corrupt_blob" and acted:
                 m["quarantined"].inc()
         findings.extend(found)
+        if repo == "models":
+            _update_quarantine_gauges(dao, m)
+    m["last_run"].set(utcnow().timestamp())
+    return findings
+
+
+def _check_divergence(registry, models_dao, repair: bool) -> List[dict]:
+    """Replica-divergence sweep (REPLICATED model source only): model
+    blobs are keyed by engine-instance id, so the id universe comes
+    from the metadata store — the localfs filename escaping is lossy,
+    which rules out enumerating the store itself."""
+    check = getattr(models_dao, "check_divergence", None)
+    if check is None:
+        return []
+    try:
+        ids = [row.id for row in
+               registry.get_meta_data_engine_instances().get_all()]
+        return check(ids, repair=repair) if ids else []
+    except (StorageError, OSError) as exc:
+        return [{"kind": "fsck_error", "repo": "models",
+                 "reason": f"divergence check failed: {exc}",
+                 "action": "none"}]
+
+
+def _update_quarantine_gauges(models_dao, m) -> None:
+    stats = getattr(models_dao, "quarantine_stats", None)
+    if stats is None:
+        return
+    try:
+        s = stats()
+    except (StorageError, OSError):
+        return
+    m["qbytes"].set(s.get("bytes", 0.0))
+    m["qcount"].set(s.get("count", 0.0))
+
+
+def quarantine_gc(registry,
+                  retention_s: float = DEFAULT_RETENTION_S) -> List[dict]:
+    """Purge quarantined blobs past the retention window on the bound
+    models store — quarantine is forensic evidence, not an archive, and
+    unbounded quarantine growth is its own disk-full incident."""
+    try:
+        dao = registry.get_model_data_models()
+    except StorageError:
+        return []
+    gc = getattr(dao, "quarantine_gc", None)
+    if gc is None:
+        return []
+    m = _metrics()
+    try:
+        findings = gc(retention_s)
+    except (StorageError, OSError) as exc:
+        findings = [{"kind": "quarantine_gc_error", "reason": str(exc),
+                     "action": "none"}]
+    for f in findings:
+        f.setdefault("repo", "models")
+        m["findings"].labels(kind=f.get("kind", "unknown")).inc()
+    _update_quarantine_gauges(dao, m)
     return findings
 
 
@@ -181,3 +254,62 @@ def startup_check(registry, log=None) -> Optional[Dict[str, object]]:
         log("fsck.startup",
             findings=len(report["fsck"]), janitor=len(report["janitor"]))
     return report
+
+
+class ScheduledFsck:
+    """Background fsck on an interval (PIO_FSCK_INTERVAL_S; off by
+    default). Each tick runs the report-only fsck pass (repairs remain
+    an explicit operator action via `pio doctor --repair`) plus
+    quarantine GC past PIO_QUARANTINE_RETENTION_S, refreshing
+    `pio_fsck_last_run_ts` / `pio_quarantine_bytes`. One instance per
+    process (the fleet control plane runs it, not every replica)."""
+
+    def __init__(self, registry, interval_s: float,
+                 retention_s: float = DEFAULT_RETENTION_S, log=None):
+        self.registry = registry
+        self.interval_s = interval_s
+        self.retention_s = retention_s
+        self.log = log
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="pio-fsck-sched", daemon=True)
+
+    def start(self) -> "ScheduledFsck":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def run_once(self) -> Dict[str, List[dict]]:
+        """One tick, callable synchronously (tests, forced sweeps)."""
+        report = {"fsck": fsck_registry(self.registry, repair=False),
+                  "gc": quarantine_gc(self.registry, self.retention_s)}
+        if self.log is not None and (report["fsck"] or report["gc"]):
+            self.log("fsck.scheduled", findings=len(report["fsck"]),
+                     gc=len(report["gc"]))
+        return report
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception as exc:
+                # a broken store must not kill the scheduler thread —
+                # the next tick retries and /metrics shows the stall
+                if self.log is not None:
+                    self.log("fsck.scheduled.error", error=str(exc))
+
+
+def start_scheduled_fsck(registry, log=None) -> Optional[ScheduledFsck]:
+    """Start the background fsck scheduler if PIO_FSCK_INTERVAL_S is
+    configured (>0); returns the handle, or None when disabled."""
+    cfg = getattr(registry, "config", {}) or {}
+    raw = str(cfg.get("PIO_FSCK_INTERVAL_S", "")).lower()
+    if raw in ("", "off", "0", "false", "no", "none"):
+        return None
+    interval = float(raw)
+    retention = float(cfg.get("PIO_QUARANTINE_RETENTION_S",
+                              DEFAULT_RETENTION_S))
+    return ScheduledFsck(registry, interval, retention, log=log).start()

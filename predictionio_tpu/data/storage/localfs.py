@@ -19,6 +19,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from predictionio_tpu.data import integrity
+from predictionio_tpu.data.event import utcnow
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
 from predictionio_tpu.resilience import FaultError, faults
@@ -94,5 +95,55 @@ class LocalFSModels(base.Models):
             if repair:
                 dest = integrity.quarantine_file(f, reason)
                 finding["action"] = f"quarantined -> {dest}"
+            findings.append(finding)
+        return findings
+
+    def quarantine_stats(self) -> dict:
+        """Footprint of `.quarantine/` (feeds pio_quarantine_bytes)."""
+        qdir = self.c.path / ".quarantine"
+        total, count = 0, 0
+        if qdir.is_dir():
+            for f in qdir.iterdir():
+                if f.name.endswith(".reason") or not f.is_file():
+                    continue
+                total += f.stat().st_size
+                count += 1
+        return {"bytes": float(total), "count": float(count)}
+
+    def quarantine_gc(self, retention_s: float) -> List[dict]:
+        """Delete quarantined blobs (and their reason sidecars) older
+        than the retention window — quarantine is a forensic holding
+        area, not an archive. Age is measured from the `.reason`
+        sidecar's mtime (stamped at quarantine time; os.replace
+        preserves the blob's own, possibly ancient, mtime), falling
+        back to the blob's mtime when the sidecar is gone."""
+        qdir = self.c.path / ".quarantine"
+        if not qdir.is_dir():
+            return []
+        now = utcnow().timestamp()
+        cutoff = now - retention_s
+        findings: List[dict] = []
+        for f in sorted(qdir.iterdir()):
+            if f.name.endswith(".reason") or not f.is_file():
+                continue
+            try:
+                sidecar = f.with_name(f.name + ".reason")
+                mtime = (sidecar.stat().st_mtime if sidecar.exists()
+                         else f.stat().st_mtime)
+            except OSError:
+                continue
+            if mtime > cutoff:
+                continue
+            age = now - mtime
+            finding = {"kind": "quarantine_expired", "path": str(f),
+                       "reason": f"quarantined {age:.0f}s ago "
+                                 f"(retention {retention_s:.0f}s)",
+                       "action": "none"}
+            try:
+                f.unlink()
+                f.with_name(f.name + ".reason").unlink(missing_ok=True)
+                finding["action"] = "deleted"
+            except OSError as exc:
+                finding["action"] = f"delete failed: {exc}"
             findings.append(finding)
         return findings

@@ -104,6 +104,12 @@ def _register_builtin_drivers() -> None:
         register_driver(type_name, objectstore.ObjectStoreStorageClient,
                         {"Models": objectstore.ObjectStoreModels})
 
+    # virtual Models source fanning out over other configured sources
+    # (quorum writes + read-repair; see replicated.py)
+    from predictionio_tpu.data.storage import replicated
+    register_driver("REPLICATED", replicated.ReplicatedStorageClient,
+                    {"Models": replicated.ReplicatedModels})
+
 
 _register_builtin_drivers()
 
@@ -211,7 +217,14 @@ class StorageRegistry:
                 if scfg["TYPE"].upper() == "SQLITE" and "PATH" in scfg:
                     Path(scfg["PATH"]).expanduser().parent.mkdir(
                         parents=True, exist_ok=True)
-                self._clients[source_name] = driver["client"](scfg)
+                factory = driver["client"]
+                if getattr(factory, "needs_registry", False):
+                    # virtual sources (REPLICATED) resolve their target
+                    # DAOs back through this registry
+                    self._clients[source_name] = factory(
+                        scfg, registry=self)
+                else:
+                    self._clients[source_name] = factory(scfg)
             return self._clients[source_name]
 
     def get_data_object(self, source_name: str, dao: str):
@@ -241,7 +254,12 @@ class StorageRegistry:
         BREAKER_THRESHOLD / BREAKER_RECOVERY_S tune the breaker;
         RETRY_BUDGET caps aggregate retry amplification (tokens,
         0/off disables)."""
-        if str(scfg.get("RESILIENCE", "on")).lower() in (
+        # REPLICATED is a virtual source: each of its targets already
+        # carries its own retry/breaker/budget wrapper, so double-
+        # wrapping would retry a quorum failure that is by design final
+        default_resilience = ("off" if scfg.get("TYPE", "").upper() ==
+                              "REPLICATED" else "on")
+        if str(scfg.get("RESILIENCE", default_resilience)).lower() in (
                 "off", "0", "false", "no"):
             return dao
         policy = RetryPolicy(

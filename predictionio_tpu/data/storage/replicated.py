@@ -1,0 +1,348 @@
+"""Replicated model store ("REPLICATED" type): quorum writes + read-repair.
+
+One torn blob or one lost disk must never cost a deploy (the fleet-ops
+posture of the ROADMAP north star). A REPLICATED source is a virtual
+Models store fanning out over N *other* configured sources:
+
+  PIO_STORAGE_SOURCES_<N>_TYPE=REPLICATED
+  PIO_STORAGE_SOURCES_<N>_REPLICAS=R1,R2,R3    (names of other sources)
+  PIO_STORAGE_SOURCES_<N>_QUORUM=2             (optional; default majority)
+
+Semantics:
+
+  - `insert`/`delete` fan out to every target and ack once a QUORUM of
+    targets succeeded (each target is independently wrapped in the
+    registry's resilience proxy, so per-target retry schedules, retry
+    budgets, and circuit breakers from PR-2/PR-3 apply before a target
+    counts as failed). Fewer acks than quorum raises StorageError.
+  - `get` reads targets in configured order and returns the first
+    INTACT copy (the PR-3 envelope checksum is the arbiter). A replica
+    that was corrupt (`CorruptBlobError`) or missing the blob is
+    READ-REPAIRED in place: the verified payload is rewritten through
+    the target's own atomic-write path, counted in
+    `pio_model_repair_total{target}`. Unreachable targets are skipped,
+    never written.
+  - `fsck` aggregates each target's own fsck pass (quarantine etc. per
+    driver) and `check_divergence(ids)` compares payload digests across
+    replicas for the given instance ids — same id, differing checksum
+    is the silent failure mode quorum writes leave behind; with
+    `repair` the majority (first-target tie-break) copy is rewritten
+    everywhere (`pio doctor --repair`).
+
+The registry hands each target DAO out through its normal construction
+path, so chaos seams (`storage.<target>.Models.*`,
+`storage.<target>.models.insert.torn`) and metrics keep their
+per-target identity — a partition of one target mid-quorum-write is
+one armed fault rule away.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# module (not name) import: integrity itself imports storage.base, so
+# when integrity is the interpreter's FIRST import this module loads
+# while integrity is mid-initialization — the module object is already
+# in sys.modules (usable at call time), its names are not yet
+from predictionio_tpu.data import integrity
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model, StorageError
+from predictionio_tpu.obs import get_logger, get_registry
+
+_log = get_logger("storage.replicated")
+
+
+def _metrics():
+    reg = get_registry()
+    return {
+        "repair": reg.counter(
+            "pio_model_repair_total",
+            "Model blobs rewritten on a replica by read-repair or "
+            "divergence repair", labels=("target",)),
+        "writes": reg.counter(
+            "pio_replica_writes_total",
+            "Per-target replica write outcomes", labels=("target",
+                                                         "outcome")),
+        "quorum": reg.counter(
+            "pio_replica_quorum_total",
+            "Quorum-acked operations by outcome", labels=("op", "outcome")),
+        "divergence": reg.counter(
+            "pio_replica_divergence_total",
+            "Instance ids found with diverging replica checksums"),
+    }
+
+
+class ReplicatedStorageClient:
+    """Holds the target-source names; DAOs are resolved lazily through
+    the owning registry so each target keeps its own resilience proxy."""
+
+    # the registry passes itself to factories advertising this flag
+    needs_registry = True
+
+    def __init__(self, config: Optional[dict] = None, registry=None):
+        self.config = dict(config or {})
+        self.registry = registry
+        self.source_name = self.config.get("SOURCE_NAME", "REPLICATED")
+        raw = self.config.get("REPLICAS", self.config.get("replicas", ""))
+        self.targets: List[str] = [t.strip() for t in raw.split(",")
+                                   if t.strip()]
+        if len(self.targets) < 2:
+            raise StorageError(
+                f"REPLICATED source {self.source_name} needs >= 2 target "
+                "sources (PIO_STORAGE_SOURCES_<N>_REPLICAS=A,B[,C...])")
+        if registry is None:
+            raise StorageError(
+                "REPLICATED source requires registry-driven construction")
+        for t in self.targets:
+            if t == self.source_name:
+                raise StorageError(
+                    f"REPLICATED source {self.source_name} lists itself "
+                    "as a replica target")
+            scfg = registry.sources.get(t)
+            if scfg is None:
+                raise StorageError(
+                    f"REPLICATED source {self.source_name}: unknown "
+                    f"target source {t!r}")
+            if scfg.get("TYPE", "").upper() == "REPLICATED":
+                raise StorageError(
+                    f"REPLICATED source {self.source_name}: target {t!r} "
+                    "is itself REPLICATED (nesting not supported)")
+        q = self.config.get("QUORUM", self.config.get("quorum"))
+        self.quorum = int(q) if q else len(self.targets) // 2 + 1
+        if not (1 <= self.quorum <= len(self.targets)):
+            raise StorageError(
+                f"REPLICATED source {self.source_name}: QUORUM "
+                f"{self.quorum} outside 1..{len(self.targets)}")
+
+
+class ReplicatedModels(base.Models):
+    """Quorum-write / read-repair Models DAO over the client's targets."""
+
+    def __init__(self, client: ReplicatedStorageClient):
+        self.c = client
+        self._lock = threading.Lock()
+        self._daos: Optional[List[Tuple[str, base.Models]]] = None
+        self._m = _metrics()
+
+    def _targets(self) -> List[Tuple[str, base.Models]]:
+        """(name, DAO) per target, resolved once through the registry
+        (each comes back wrapped in its own resilience proxy)."""
+        with self._lock:
+            if self._daos is None:
+                self._daos = [
+                    (t, self.c.registry.get_data_object(t, "Models"))
+                    for t in self.c.targets]
+            return self._daos
+
+    # -- writes -------------------------------------------------------------
+    def _fan_out(self, op: str, fn) -> None:
+        acks, failures = 0, []
+        for name, dao in self._targets():
+            try:
+                fn(dao)
+                acks += 1
+                self._m["writes"].labels(target=name, outcome="ok").inc()
+            except Exception as e:
+                failures.append((name, e))
+                self._m["writes"].labels(target=name,
+                                         outcome="failed").inc()
+                _log.warning("replica_write_failed", op=op, target=name,
+                             error=f"{type(e).__name__}: {e}")
+        if acks < self.c.quorum:
+            self._m["quorum"].labels(op=op, outcome="failed").inc()
+            detail = "; ".join(f"{n}: {type(e).__name__}: {e}"
+                               for n, e in failures)
+            raise StorageError(
+                f"replicated {op}: quorum not met "
+                f"({acks}/{self.c.quorum} of {len(self.c.targets)} "
+                f"targets acked; failures: {detail})")
+        self._m["quorum"].labels(op=op, outcome="ok").inc()
+
+    def insert(self, m: Model) -> None:
+        self._fan_out("insert", lambda dao: dao.insert(m))
+
+    def delete(self, mid: str) -> None:
+        self._fan_out("delete", lambda dao: dao.delete(mid))
+
+    # -- reads + read-repair ------------------------------------------------
+    def get(self, mid: str) -> Optional[Model]:
+        """First intact copy wins; earlier replicas that were corrupt or
+        missing the blob are repaired from it (envelope-level
+        read-repair). Targets that ERRORED (unreachable/breaker-open)
+        are skipped and never written — repair needs positive evidence
+        the replica is alive but wrong, not merely silent."""
+        stale: List[Tuple[str, base.Models, str]] = []   # needs rewrite
+        errors: List[Exception] = []
+        saw_target = False
+        for name, dao in self._targets():
+            try:
+                model = dao.get(mid)
+            except integrity.CorruptBlobError as e:
+                # the replica answered — positive evidence it is alive
+                # but wrong, which is exactly what repair needs
+                saw_target = True
+                stale.append((name, dao, f"corrupt: {e}"))
+                continue
+            except (StorageError, OSError) as e:
+                errors.append(e)
+                continue
+            saw_target = True
+            if model is None:
+                stale.append((name, dao, "missing"))
+                continue
+            self._repair(mid, model, stale)
+            return model
+        if saw_target:
+            # every reachable replica agreed the blob does not exist
+            if any(reason.startswith("corrupt") for _, _, reason in stale):
+                raise integrity.CorruptBlobError(
+                    f"model {mid}: every replica holding the blob is "
+                    "corrupt; no intact copy to repair from")
+            return None
+        if errors:
+            raise errors[-1]
+        return None
+
+    def _repair(self, mid: str, model: Model,
+                stale: Sequence[Tuple[str, base.Models, str]]) -> None:
+        for name, dao, reason in stale:
+            try:
+                dao.insert(model)
+            except Exception as e:
+                _log.warning("read_repair_failed", id=mid, target=name,
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            self._m["repair"].labels(target=name).inc()
+            _log.warning("read_repair", id=mid, target=name, was=reason)
+
+    # -- fsck / divergence ---------------------------------------------------
+    def fsck(self, repair: bool = False) -> List[dict]:
+        """Each target's own fsck pass, findings tagged with the target
+        name. A target whose fsck itself fails contributes one
+        `fsck_error` finding instead of aborting the sweep."""
+        findings: List[dict] = []
+        for name, dao in self._targets():
+            run = getattr(dao, "fsck", None)
+            if run is None:
+                continue
+            try:
+                found = run(repair=repair)
+            except (StorageError, OSError) as e:
+                found = [{"kind": "fsck_error", "reason": str(e),
+                          "action": "none"}]
+            for f in found:
+                f.setdefault("target", name)
+            findings.extend(found)
+        return findings
+
+    def check_divergence(self, ids: Sequence[str],
+                         repair: bool = False) -> List[dict]:
+        """Compare payload digests for each instance id across replicas.
+
+        Divergence = same id, differing checksums (or a copy missing /
+        corrupt on some replicas) — what a partitioned target misses
+        during a quorum write, or silent rot fsck alone can't arbitrate.
+        With `repair`, the majority digest (first-target order breaks
+        ties) is rewritten to every disagreeing replica."""
+        findings: List[dict] = []
+        targets = self._targets()
+        for mid in ids:
+            copies: Dict[str, Optional[Model]] = {}
+            states: Dict[str, str] = {}
+            for name, dao in targets:
+                try:
+                    m = dao.get(mid)
+                except integrity.CorruptBlobError:
+                    states[name] = "corrupt"
+                    copies[name] = None
+                    continue
+                except (StorageError, OSError) as e:
+                    states[name] = f"unreachable: {type(e).__name__}"
+                    copies[name] = None
+                    continue
+                if m is None:
+                    states[name] = "missing"
+                    copies[name] = None
+                else:
+                    states[name] = "sha256:" + hashlib.sha256(
+                        m.models).hexdigest()[:16]
+                    copies[name] = m
+            digests = [s for s in states.values() if s.startswith("sha256:")]
+            if not digests:
+                continue   # nowhere intact: nothing to arbitrate
+            if len(set(states.values())) == 1:
+                continue   # all replicas agree
+            self._m["divergence"].inc()
+            finding = {"kind": "replica_divergence", "id": mid,
+                       "replicas": dict(states),
+                       "reason": "replica checksums disagree",
+                       "action": "none"}
+            if repair:
+                finding["action"] = self._repair_divergence(
+                    mid, targets, states, copies)
+            findings.append(finding)
+        return findings
+
+    def _repair_divergence(self, mid, targets, states, copies) -> str:
+        # majority digest wins; ties break in configured target order
+        counts: Dict[str, int] = {}
+        for s in states.values():
+            if s.startswith("sha256:"):
+                counts[s] = counts.get(s, 0) + 1
+        best = max(counts.values())
+        winner = next(s for n, _ in targets
+                      if (s := states[n]).startswith("sha256:")
+                      and counts[s] == best)
+        source = next(copies[n] for n, _ in targets if states[n] == winner)
+        repaired = []
+        for name, dao in targets:
+            if states[name] == winner \
+                    or states[name].startswith("unreachable"):
+                continue
+            try:
+                dao.insert(source)
+            except Exception as e:
+                _log.warning("divergence_repair_failed", id=mid,
+                             target=name,
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            self._m["repair"].labels(target=name).inc()
+            repaired.append(name)
+        return (f"rewrote {','.join(repaired)} from {winner}"
+                if repaired else "repair failed on every replica")
+
+    # -- quarantine delegation ----------------------------------------------
+    def quarantine_stats(self) -> Dict[str, float]:
+        """Aggregate quarantine footprint across targets (for the
+        `pio_quarantine_bytes` gauge)."""
+        total = {"bytes": 0.0, "count": 0.0}
+        for _, dao in self._targets():
+            stats = getattr(dao, "quarantine_stats", None)
+            if stats is None:
+                continue
+            try:
+                s = stats()
+            except (StorageError, OSError):
+                continue
+            total["bytes"] += s.get("bytes", 0.0)
+            total["count"] += s.get("count", 0.0)
+        return total
+
+    def quarantine_gc(self, retention_s: float) -> List[dict]:
+        """Chain each target's quarantine GC (scheduled-fsck retention)."""
+        findings: List[dict] = []
+        for name, dao in self._targets():
+            gc = getattr(dao, "quarantine_gc", None)
+            if gc is None:
+                continue
+            try:
+                found = gc(retention_s)
+            except (StorageError, OSError) as e:
+                found = [{"kind": "quarantine_gc_error", "reason": str(e),
+                          "action": "none"}]
+            for f in found:
+                f.setdefault("target", name)
+            findings.extend(found)
+        return findings

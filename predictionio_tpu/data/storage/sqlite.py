@@ -20,7 +20,7 @@ from pathlib import Path
 from typing import Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.event import (
-    DataMap, Event, from_millis, to_millis,
+    DataMap, Event, from_millis, to_millis, utcnow,
 )
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
@@ -61,7 +61,8 @@ META_DDL = (
     """CREATE TABLE IF NOT EXISTS models (
         id TEXT PRIMARY KEY, models BLOB)""",
     """CREATE TABLE IF NOT EXISTS models_quarantine (
-        id TEXT PRIMARY KEY, models BLOB, reason TEXT)""",
+        id TEXT PRIMARY KEY, models BLOB, reason TEXT,
+        quarantined_at INTEGER)""",
 )
 
 # Additive schema migrations for stores created before a column existed;
@@ -70,6 +71,7 @@ META_DDL = (
 # through its dialect translation.
 META_MIGRATIONS = (
     "ALTER TABLE engine_instances ADD COLUMN heartbeat INTEGER",
+    "ALTER TABLE models_quarantine ADD COLUMN quarantined_at INTEGER",
 )
 
 
@@ -434,15 +436,47 @@ class SQLiteModels(base.Models):
             finding = {"kind": "corrupt_blob", "id": mid,
                        "reason": reason, "action": "none"}
             if repair:
+                now_ms = int(utcnow().timestamp() * 1000)
                 with self.c.lock, self.c.conn:
                     self.c.conn.execute(
                         "INSERT OR REPLACE INTO models_quarantine "
-                        "(id, models, reason) VALUES (?,?,?)",
-                        (mid, blob, reason))
+                        "(id, models, reason, quarantined_at) "
+                        "VALUES (?,?,?,?)",
+                        (mid, blob, reason, now_ms))
                     self.c.conn.execute(
                         "DELETE FROM models WHERE id=?", (mid,))
                 finding["action"] = "quarantined -> models_quarantine"
             findings.append(finding)
+        return findings
+
+    def quarantine_stats(self) -> dict:
+        """Footprint of models_quarantine (feeds pio_quarantine_bytes)."""
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT COUNT(*), COALESCE(SUM(LENGTH(models)), 0) "
+                "FROM models_quarantine").fetchone()
+        return {"bytes": float(row[1]), "count": float(row[0])}
+
+    def quarantine_gc(self, retention_s: float) -> List[dict]:
+        """Drop quarantined rows past the retention window. Rows from
+        before the quarantined_at column existed (NULL) are treated as
+        expired — they predate any plausible retention window."""
+        cutoff_ms = int((utcnow().timestamp() - retention_s) * 1000)
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id, LENGTH(models), quarantined_at "
+                "FROM models_quarantine WHERE quarantined_at IS NULL "
+                "OR quarantined_at <= ?", (cutoff_ms,)).fetchall()
+        findings: List[dict] = []
+        for mid, size, qat in rows:
+            with self.c.lock, self.c.conn:
+                self.c.conn.execute(
+                    "DELETE FROM models_quarantine WHERE id=?", (mid,))
+            findings.append({
+                "kind": "quarantine_expired", "id": mid,
+                "reason": f"quarantined row ({size or 0}B) past "
+                          f"{retention_s:.0f}s retention",
+                "action": "deleted"})
         return findings
 
 

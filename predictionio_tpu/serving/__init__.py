@@ -16,6 +16,9 @@ scales with concurrency instead of degrading.
 from predictionio_tpu.serving.server import (  # noqa: F401
     PredictionServer, ServerConfig,
 )
+from predictionio_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig, FleetServer,
+)
 from predictionio_tpu.serving.plugins import (  # noqa: F401
     EngineServerPlugin, EngineServerPluginContext, OUTPUT_BLOCKER,
     OUTPUT_SNIFFER, QueryInfo,

@@ -1,0 +1,502 @@
+"""Fleet control plane: replica-set serving + zero-downtime rolling
+reloads.
+
+`pio-tpu deploy --replicas N` puts N in-process `PredictionServer`
+workers (each with its own micro-batcher, deployment, and loopback
+port) behind this router. The control plane:
+
+  - health-gates routing: a replica serves traffic only while admitted;
+    the monitor thread probes each replica's `/ready` every
+    `health_interval_s` and ejects after `eject_threshold` consecutive
+    failures (probe failures and routing-observed connection errors /
+    5xx responses feed the same counter), re-admitting on the first
+    healthy probe after recovery
+  - routes `/queries.json` round-robin over admitted replicas and
+    RETRIES connection-level failures on the next healthy replica, so
+    a replica dying mid-request costs the client nothing; HTTP error
+    responses (the replica answered — a 503 shed, a 400 bad query)
+    pass through untouched
+  - implements rolling `/reload`: one replica at a time is ejected
+    from routing, drained (its in-flight proxied requests finish),
+    reloaded (the replica's own PR-2 last-good rollback + PR-4
+    warm_deploy apply inside its /reload), probed, and re-admitted
+    before the next begins. A replica that DIES mid-reload is left
+    ejected and the roll continues (N-1 replicas still serve); a
+    replica whose load FAILS (HTTP 500, rolled back to last-good) is
+    re-admitted on the old model and the roll ABORTS — the new model
+    is bad and would fail on every other replica too.
+
+One fsck/janitor sweep runs per fleet (the control plane's; replicas
+are built with `startup_check=False`), as does the single scheduled
+background fsck thread (PIO_FSCK_INTERVAL_S).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from predictionio_tpu.obs import MetricsRegistry, get_logger
+from predictionio_tpu.resilience import current_deadline
+from predictionio_tpu.serving.server import PredictionServer, ServerConfig
+from predictionio_tpu.utils.http import (
+    HTTPError, HTTPServerBase, Request, Response,
+)
+
+_log = get_logger("serving.fleet")
+
+# headers forwarded verbatim to the replica (deadline propagation,
+# request-id correlation, auth)
+_FORWARD_HEADERS = ("X-PIO-Deadline-Ms", "X-Request-ID", "Authorization",
+                    "Content-Type")
+
+
+@dataclass
+class FleetConfig:
+    """Control-plane knobs (the ServerConfig carries everything the
+    replicas themselves need)."""
+    replicas: int = 3
+    # /ready probe cadence for the health monitor
+    health_interval_s: float = 1.0
+    # consecutive failures (probe, connection, 5xx) before ejection
+    eject_threshold: int = 3
+    # per-attempt proxy timeout when the request carries no deadline
+    proxy_timeout_s: float = 30.0
+    # rolling reload: max wait for a replica's in-flight requests
+    drain_timeout_s: float = 10.0
+
+
+class _Replica:
+    """One managed PredictionServer worker and its routing state."""
+
+    def __init__(self, index: int, server: PredictionServer):
+        self.index = index
+        self.server = server
+        self.port = 0
+        self.lock = threading.Lock()
+        self.admitted = False
+        self.state = "starting"   # serving|ejected|reloading|dead
+        self.failures = 0         # consecutive probe/route failures
+        self.inflight = 0
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {"replica": self.index, "port": self.port,
+                    "state": self.state, "admitted": self.admitted,
+                    "failures": self.failures, "inflight": self.inflight}
+
+
+class FleetServer(HTTPServerBase):
+    """The tiny control plane in front of N PredictionServer replicas."""
+
+    def __init__(self, config: ServerConfig,
+                 fleet: Optional[FleetConfig] = None, registry=None,
+                 plugins: Optional[Sequence] = None, engine=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        super().__init__(host=config.ip, port=config.port, metrics=metrics,
+                         default_deadline_ms=config.default_deadline_ms,
+                         max_inflight=config.max_inflight)
+        from predictionio_tpu.core import RuntimeContext
+        from predictionio_tpu.utils.security import KeyAuthentication
+
+        self.config = config
+        self.fleet = fleet if fleet is not None else FleetConfig()
+        if self.fleet.replicas < 1:
+            raise ValueError("a fleet needs at least 1 replica")
+        self.ctx = RuntimeContext(registry=registry)
+        self.auth = KeyAuthentication(config.server_key or None)
+        self._engine_arg = engine
+        self._plugins = plugins
+        self._rr_lock = threading.Lock()
+        self._rr_next = 0
+        self._reload_lock = threading.Lock()
+        self._stopping = False
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._fleet_obs = _fleet_metrics(self.metrics)
+        # ONE recovery sweep + ONE scheduled-fsck thread per fleet
+        from predictionio_tpu.data.fsck import (
+            start_scheduled_fsck, startup_check,
+        )
+        startup_check(self.ctx.registry, log=_log.warning)
+        self._fsck_sched = start_scheduled_fsck(
+            self.ctx.registry, log=_log.warning)
+        self._replicas: List[_Replica] = []
+        self._routes()
+
+    # -- lifecycle ----------------------------------------------------------
+    def _replica_config(self) -> ServerConfig:
+        """Replicas bind loopback ephemeral ports, skip the per-process
+        fsck sweep, and never probe/undeploy a port occupant (the fleet
+        owns the public port; replica ports are fresh)."""
+        return dataclasses.replace(
+            self.config, ip="127.0.0.1", port=0, startup_check=False,
+            max_inflight=0)
+
+    def start(self, background: bool = True) -> int:
+        for i in range(self.fleet.replicas):
+            server = PredictionServer(
+                self._replica_config(), registry=self.ctx.registry,
+                plugins=self._plugins, engine=self._engine_arg,
+                metrics=self.metrics)
+            rep = _Replica(i, server)
+            rep.port = server.start(background=True)
+            self._replicas.append(rep)
+            if self._probe(rep):
+                self._admit(rep)
+            _log.info("replica_started", replica=i, port=rep.port,
+                      admitted=rep.admitted)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="pio-fleet-health", daemon=True)
+        self._monitor.start()
+        return super().start(background)
+
+    def stop(self) -> None:
+        """Stop the fleet: replicas drain gracefully (their stop()
+        finishes accepted work), then the router socket closes."""
+        with self._rr_lock:
+            if self._stopping:
+                return
+            self._stopping = True
+        self._monitor_stop.set()
+        for rep in self._replicas:
+            with rep.lock:
+                rep.admitted = False
+                rep.state = "stopping"
+            try:
+                rep.server.stop()
+            except Exception as e:
+                _log.warning("replica_stop_failed", replica=rep.index,
+                             error=f"{type(e).__name__}: {e}")
+        if self._fsck_sched is not None:
+            self._fsck_sched.stop()
+        self.shutdown()
+
+    def readiness(self):
+        """/ready: the fleet serves while >=1 replica is admitted."""
+        admitted = [r.index for r in self._replicas
+                    if r.admitted and r.server.is_running()]
+        return (bool(admitted),
+                {"replicas": len(self._replicas), "admitted": admitted})
+
+    # -- health gating ------------------------------------------------------
+    def _probe(self, rep: _Replica) -> bool:
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rep.port}/ready", method="GET")
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                return resp.status == 200
+        except urllib.error.HTTPError:
+            return False          # answered but not ready
+        except OSError:
+            return False          # unreachable
+        except Exception:
+            return False
+
+    def _admit(self, rep: _Replica) -> None:
+        with rep.lock:
+            was = rep.admitted
+            rep.admitted = True
+            rep.state = "serving"
+            rep.failures = 0
+        if not was:
+            self._fleet_obs["transitions"].labels(event="admit").inc()
+        self._update_gauges()
+
+    def _eject(self, rep: _Replica, reason: str) -> None:
+        with rep.lock:
+            was = rep.admitted
+            rep.admitted = False
+            if rep.state == "serving":
+                rep.state = "ejected"
+        if was:
+            self._fleet_obs["transitions"].labels(event="eject").inc()
+            _log.warning("replica_ejected", replica=rep.index,
+                         reason=reason)
+        self._update_gauges()
+
+    def _record_failure(self, rep: _Replica, reason: str) -> None:
+        with rep.lock:
+            rep.failures += 1
+            over = rep.failures >= self.fleet.eject_threshold
+        if over:
+            self._eject(rep, reason)
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(self.fleet.health_interval_s):
+            for rep in self._replicas:
+                with rep.lock:
+                    skip = rep.state in ("reloading", "stopping")
+                if skip:
+                    continue
+                if self._probe(rep):
+                    self._admit(rep)
+                else:
+                    self._record_failure(rep, "readiness probe failed")
+
+    def _update_gauges(self) -> None:
+        admitted = sum(1 for r in self._replicas if r.admitted)
+        self._fleet_obs["admitted"].set(float(admitted))  # lint: ok — host int
+        self._fleet_obs["size"].set(float(len(self._replicas)))
+
+    # -- routing ------------------------------------------------------------
+    def _rotation(self) -> List[_Replica]:
+        """Admitted replicas, round-robin rotated so consecutive
+        requests spread; the non-admitted are excluded entirely."""
+        admitted = [r for r in self._replicas if r.admitted]
+        if not admitted:
+            return []
+        with self._rr_lock:
+            start = self._rr_next % len(admitted)
+            self._rr_next += 1
+        return admitted[start:] + admitted[:start]
+
+    def _proxy(self, rep: _Replica, req: Request, timeout: float
+               ) -> Response:
+        """Forward one request to one replica. An HTTP error status is
+        a RESPONSE (the replica is alive and answered — pass it
+        through); only transport-level failures raise OSError to the
+        retry loop."""
+        url = f"http://127.0.0.1:{rep.port}{req.path}"
+        headers = {}
+        for name in _FORWARD_HEADERS:
+            v = req.header(name)
+            if v:
+                headers[name] = v
+        proxied = urllib.request.Request(
+            url, data=req.body if req.method == "POST" else None,
+            method=req.method, headers=headers)
+        try:
+            with urllib.request.urlopen(proxied, timeout=timeout) as resp:
+                return Response(
+                    status=resp.status, body=resp.read(),
+                    content_type=resp.headers.get(
+                        "Content-Type", "application/json"))
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            return Response(
+                status=e.code, body=body,
+                content_type=e.headers.get(
+                    "Content-Type", "application/json"))
+
+    def _route(self, req: Request) -> Response:
+        """Route to an admitted replica; connection-level failures are
+        retried on the NEXT admitted replica (zero failed client
+        requests when a replica dies), each failure feeding the
+        ejection counter."""
+        deadline = current_deadline()
+        rotation = self._rotation()
+        if not rotation:
+            self._fleet_obs["routed"].labels(outcome="no_replica").inc()
+            raise HTTPError(503, "no healthy replica available",
+                            headers={"Retry-After": "1"})
+        last_err: Optional[Exception] = None
+        for rep in rotation:
+            timeout = self.fleet.proxy_timeout_s
+            if deadline is not None:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    break   # let the deadline middleware answer 504
+                timeout = min(timeout, remaining)
+            with rep.lock:
+                rep.inflight += 1
+            try:
+                resp = self._proxy(rep, req, timeout)
+            except OSError as e:
+                last_err = e
+                self._record_failure(
+                    rep, f"route error: {type(e).__name__}: {e}")
+                self._fleet_obs["routed"].labels(outcome="retried").inc()
+                continue
+            finally:
+                with rep.lock:
+                    rep.inflight -= 1
+            if resp.status >= 500:
+                # the replica answered; pass the response through but
+                # feed the error threshold (a replica shedding 503s or
+                # erroring 500s should leave rotation until it recovers)
+                self._record_failure(rep, f"HTTP {resp.status}")
+            else:
+                with rep.lock:
+                    rep.failures = 0
+            self._fleet_obs["routed"].labels(outcome="ok").inc()
+            return resp
+        self._fleet_obs["routed"].labels(outcome="exhausted").inc()
+        raise HTTPError(
+            503,
+            f"every admitted replica unreachable "
+            f"(last: {type(last_err).__name__ if last_err else 'n/a'})",
+            headers={"Retry-After": "1"})
+
+    # -- rolling reload -----------------------------------------------------
+    def _await_drain(self, rep: _Replica) -> bool:
+        """Wait (bounded) for the router's in-flight requests to this
+        replica to finish; new traffic is already diverted."""
+        waiter = threading.Event()
+        end = time.perf_counter() + self.fleet.drain_timeout_s
+        while time.perf_counter() < end:
+            with rep.lock:
+                if rep.inflight == 0:
+                    return True
+            waiter.wait(0.02)
+        with rep.lock:
+            return rep.inflight == 0
+
+    def _reload_replica(self, rep: _Replica) -> dict:
+        """POST /reload on one replica (its own last-good rollback and
+        warm_deploy run inside). Transport failure -> 'died'."""
+        headers = {}
+        if self.config.server_key:
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                f"{self.config.server_key}:".encode()).decode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rep.port}/reload", data=b"",
+            method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return {"status": resp.status}
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read()).get("message", "")
+            except Exception:
+                pass
+            return {"status": e.code, "detail": detail}
+        except OSError as e:
+            return {"status": 0, "detail": f"{type(e).__name__}: {e}"}
+
+    def rolling_reload(self) -> dict:
+        """One replica at a time: eject -> drain -> reload -> probe ->
+        re-admit -> next. See the module docstring for the failure
+        policy (dead replica: continue; failed load: abort)."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise HTTPError(409, "a rolling reload is already running")
+        try:
+            results: List[dict] = []
+            aborted = False
+            for rep in self._replicas:
+                if not rep.server.is_running():
+                    results.append({"replica": rep.index,
+                                    "outcome": "skipped_dead"})
+                    continue
+                with rep.lock:
+                    rep.admitted = False
+                    rep.state = "reloading"
+                self._fleet_obs["transitions"].labels(
+                    event="reload_start").inc()
+                self._update_gauges()
+                drained = self._await_drain(rep)
+                outcome = self._reload_replica(rep)
+                if outcome["status"] == 200:
+                    ok = self._probe(rep)
+                    if ok:
+                        self._admit(rep)
+                    else:
+                        with rep.lock:
+                            rep.state = "ejected"
+                    results.append({
+                        "replica": rep.index,
+                        "outcome": "reloaded" if ok else "reloaded_not_ready",
+                        "drained": drained})
+                elif outcome["status"] == 0:
+                    # transport failure: the replica died mid-reload.
+                    # Leave it ejected — the monitor re-admits if it
+                    # ever comes back — and keep rolling: N-1 replicas
+                    # are still serving the old or new model.
+                    with rep.lock:
+                        rep.state = "dead"
+                    self._update_gauges()
+                    _log.warning("reload_replica_died", replica=rep.index,
+                                 detail=outcome.get("detail", ""))
+                    results.append({"replica": rep.index,
+                                    "outcome": "died",
+                                    "detail": outcome.get("detail", "")})
+                else:
+                    # the replica answered non-200: the LOAD failed and
+                    # its last-good rollback kept the old model serving.
+                    # Re-admit it and ABORT — the new model is bad and
+                    # would fail identically on every remaining replica.
+                    if self._probe(rep):
+                        self._admit(rep)
+                    results.append({"replica": rep.index,
+                                    "outcome": "load_failed_rolled_back",
+                                    "detail": outcome.get("detail", "")})
+                    aborted = True
+                    break
+            report = {"results": results, "aborted": aborted}
+            self._fleet_obs["rolls"].labels(
+                outcome="aborted" if aborted else "ok").inc()
+            _log.info("rolling_reload_done", aborted=aborted,
+                      results=len(results))
+            return report
+        finally:
+            self._reload_lock.release()
+
+    # -- routes -------------------------------------------------------------
+    def _routes(self) -> None:
+        r = self.router
+
+        @r.post("/queries.json")
+        def queries(req: Request) -> Response:
+            return self._route(req)
+
+        @r.get("/status.json")
+        def status(req: Request) -> Response:
+            return Response.json({
+                "status": "alive",
+                "role": "fleet",
+                "replicas": [rep.snapshot() for rep in self._replicas],
+            })
+
+        @r.get("/")
+        def index(req: Request) -> Response:
+            rows = "".join(
+                f"<tr><td>{s['replica']}</td><td>{s['port']}</td>"
+                f"<td>{s['state']}</td><td>{s['failures']}</td></tr>"
+                for s in (rep.snapshot() for rep in self._replicas))
+            return Response.html(
+                "<html><head><title>PredictionIO-TPU fleet</title></head>"
+                "<body><h1>Fleet control plane</h1>"
+                "<table><tr><th>replica</th><th>port</th><th>state</th>"
+                f"<th>failures</th></tr>{rows}</table></body></html>")
+
+        @r.post("/reload")
+        def reload(req: Request) -> Response:
+            self.auth.check(req)
+            report = self.rolling_reload()
+            status = 500 if report["aborted"] else 200
+            return Response.json(report, status=status)
+
+        @r.post("/stop")
+        def stop(req: Request) -> Response:
+            self.auth.check(req)
+            threading.Thread(target=self.stop, daemon=True).start()
+            return Response.json({"message": "Fleet shutting down"})
+
+
+def _fleet_metrics(metrics: MetricsRegistry):
+    return {
+        "routed": metrics.counter(
+            "pio_fleet_routed_total",
+            "Router outcomes (ok/retried/no_replica/exhausted)",
+            labels=("outcome",)),
+        "transitions": metrics.counter(
+            "pio_fleet_transitions_total",
+            "Replica lifecycle events (admit/eject/reload_start)",
+            labels=("event",)),
+        "rolls": metrics.counter(
+            "pio_fleet_rolling_reload_total",
+            "Rolling reloads by outcome", labels=("outcome",)),
+        "admitted": metrics.gauge(
+            "pio_fleet_replicas_admitted",
+            "Replicas currently admitted to routing"),
+        "size": metrics.gauge(
+            "pio_fleet_replicas_total", "Replicas managed by the fleet"),
+    }

@@ -93,6 +93,10 @@ class _ServeInstruments:
         self.queue_depth = metrics.gauge(
             "pio_serve_batch_queue_depth",
             "Requests waiting in the micro-batcher")
+        self.queue_delay = metrics.histogram(
+            "pio_queue_delay_seconds",
+            "Micro-batch enqueue->drain latency (feeds the adaptive "
+            "shed decision)")
         self.feedback = metrics.counter(
             "pio_feedback_events_total",
             "Feedback events by outcome (sent/failed/dropped)",
@@ -148,6 +152,13 @@ class ServerConfig:
     # guards both with authenticate(withAccessKeyFromFile),
     # CreateServer.scala:624-637). Sourced from PIO_SERVER_ACCESS_KEY.
     server_key: str = ""
+    # run the startup fsck/janitor pass and own the scheduled-fsck
+    # thread. Fleet replicas set False: the control plane runs ONE
+    # sweep per fleet, not one per replica hammering the same store
+    startup_check: bool = True
+    # how long stop() waits for accepted requests to drain before the
+    # socket closes
+    drain_timeout_ms: int = 10000
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -258,7 +269,19 @@ class _MicroBatcher:
     else the `submit_timeout_s` backstop — so a wedged or crashed drainer
     turns into a 504, never a stranded handler thread. A drainer that
     dies on an unexpected error fails every pending waiter and clears
-    the drain flag so the next submit starts a fresh one."""
+    the drain flag so the next submit starts a fresh one.
+
+    Adaptive shedding: every drained item's enqueue->drain latency
+    lands in pio_queue_delay_seconds and an EWMA of it; a submit whose
+    deadline budget (or the submit-timeout backstop) is already below
+    that EWMA is shed at ADMISSION with 503 + Retry-After instead of
+    being queued to die into a 504 — the queue-delay signal reacts to
+    slow drains long before the static queue_max cap fills. The EWMA
+    only sheds while work is actually pending, so it self-corrects:
+    admitted traffic keeps draining and decays a stale spike."""
+
+    # EWMA smoothing for the observed enqueue->drain latency
+    DELAY_ALPHA = 0.2
 
     def __init__(self, window_s: float, batch_max: int,
                  obs: Optional[_ServeInstruments] = None,
@@ -271,23 +294,46 @@ class _MicroBatcher:
         self._lock = threading.Lock()
         # wakes the drainer the moment a full batch forms, so a batch
         # that fills mid-window ships immediately instead of sleeping
-        # out the rest of the window
+        # out the rest of the window; also signals close() waiters on
+        # retire (predicate re-checked, spurious wakeups harmless)
         self._full = threading.Condition(self._lock)
-        # each item: (deployment, query, done event, result slot)
+        # each item: (deployment, query, done event, result slot,
+        #             enqueue perf_counter)
         self._pending: List[tuple] = []
         self._draining = False
+        self._closed = False
+        self._delay_ewma = 0.0
+
+    def queue_delay_ewma(self) -> float:
+        """Current smoothed enqueue->drain latency estimate (seconds)."""
+        with self._lock:
+            return self._delay_ewma
 
     def submit(self, deployment: _Deployment, query: Any,
                deadline: Optional[Deadline] = None) -> Any:
         done = threading.Event()
         slot: Dict[str, Any] = {}
-        item = (deployment, query, done, slot)
+        item = (deployment, query, done, slot, time.perf_counter())
         with self._lock:
+            if self._closed:
+                self.obs.shed.labels(surface="queries").inc()
+                raise OverloadedError(
+                    "server draining for shutdown", retry_after=1.0)
             if self.queue_max > 0 and len(self._pending) >= self.queue_max:
                 self.obs.shed.labels(surface="queries").inc()
                 raise OverloadedError(
                     "micro-batch queue full",
                     retry_after=max(self.window_s, 0.05))
+            # adaptive shed: don't queue work predicted to expire there
+            budget = self.submit_timeout_s
+            if deadline is not None:
+                budget = min(budget, max(deadline.remaining(), 0.0))
+            if self._pending and self._delay_ewma > budget:
+                self.obs.shed.labels(surface="queue_delay").inc()
+                raise OverloadedError(
+                    f"predicted queue delay {self._delay_ewma * 1e3:.0f}ms"
+                    f" exceeds request budget {budget * 1e3:.0f}ms",
+                    retry_after=self._delay_ewma)
             self._pending.append(item)
             self.obs.queue_depth.set(float(len(self._pending)))
             if len(self._pending) >= self.batch_max:
@@ -335,9 +381,16 @@ class _MicroBatcher:
                         # nothing arrived during the window: retire. The
                         # flag is cleared under the same lock any submit
                         # checks, so the next arrival starts a fresh
-                        # drainer.
+                        # drainer; close() waiters re-check now.
                         self._draining = False
+                        self._full.notify_all()
                         return
+                    now = time.perf_counter()
+                    for _, _, _, _, t_enq in batch:
+                        delay = max(now - t_enq, 0.0)
+                        self.obs.queue_delay.observe(delay)
+                        self._delay_ewma += self.DELAY_ALPHA * (
+                            delay - self._delay_ewma)
                 self._process(batch)
                 batch = []
         except BaseException as e:
@@ -349,13 +402,30 @@ class _MicroBatcher:
                 stranded = batch + self._pending
                 self._pending = []
                 self._draining = False
+                self._full.notify_all()
                 self.obs.queue_depth.set(0.0)
-            for _, _, done, slot in stranded:
+            for _, _, done, slot, _ in stranded:
                 slot["error"] = e
                 done.set()
             _log.error("batch_drainer_crashed",
                        error=f"{type(e).__name__}: {e}",
                        stranded=len(stranded))
+
+    def close(self, timeout: float = 30.0) -> bool:
+        """Stop admitting (new submits shed with 503) and wait for
+        every accepted request to drain; True when fully drained. The
+        graceful half of PredictionServer.stop() — a replica being
+        rotated out of a rolling reload finishes what it accepted."""
+        with self._lock:
+            self._closed = True
+            return self._full.wait_for(
+                lambda: not self._pending and not self._draining,
+                timeout=timeout)
+
+    def reopen(self) -> None:
+        """Re-admit after a drain (a reload drains without stopping)."""
+        with self._lock:
+            self._closed = False
 
     def _process(self, pending: List[tuple]) -> None:
         if not pending:
@@ -367,14 +437,14 @@ class _MicroBatcher:
             by_dep.setdefault(id(item[0]), []).append(item)
         for items in by_dep.values():
             dep = items[0][0]
-            queries = [q for _, q, _, _ in items]
+            queries = [q for _, q, _, _, _ in items]
             try:
                 results = dep.predict_batch(queries)
-                for (_, _, done, slot), r in zip(items, results):
+                for (_, _, done, slot, _), r in zip(items, results):
                     slot["result"] = r
                     done.set()
             except Exception as e:
-                for _, _, done, slot in items:
+                for _, _, done, slot, _ in items:
                     slot["error"] = e
                     done.set()
 
@@ -423,9 +493,18 @@ class PredictionServer(HTTPServerBase):
                              daemon=True).start()
         # restart-recovery pass BEFORE the first model load: report-only
         # fsck + acting janitor, so a crashed train's ghost row can't
-        # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables)
-        from predictionio_tpu.data.fsck import startup_check
-        startup_check(self.ctx.registry, log=_log.warning)
+        # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables;
+        # fleet replicas skip it wholesale — the control plane owns the
+        # one sweep per fleet, including the scheduled background pass)
+        self._fsck_sched = None
+        self._stopping = False
+        if config.startup_check:
+            from predictionio_tpu.data.fsck import (
+                start_scheduled_fsck, startup_check,
+            )
+            startup_check(self.ctx.registry, log=_log.warning)
+            self._fsck_sched = start_scheduled_fsck(
+                self.ctx.registry, log=_log.warning)
         # warm-start the topk dispatch policy from the last run's learned
         # host/device crossover before any serve traffic arrives
         self._restore_dispatch_state()
@@ -560,6 +639,41 @@ class PredictionServer(HTTPServerBase):
                     pass
         return super().start(background)
 
+    def stop(self) -> None:
+        """Graceful shutdown: drain the micro-batcher (accepted
+        requests finish; new submits shed 503), flush the feedback
+        queue, stop the scheduled-fsck thread, THEN close the socket —
+        a replica rotated out during a rolling reload, or a plain
+        undeploy, never abandons a request it already accepted."""
+        with self._stats_lock:
+            if self._stopping:
+                return
+            self._stopping = True
+        budget = max(self.config.drain_timeout_ms / 1000.0, 0.1)
+        t0 = time.perf_counter()
+        if self._batcher is not None:
+            if not self._batcher.close(timeout=budget):
+                _log.warning("stop_drain_incomplete",
+                             waited_s=round(time.perf_counter() - t0, 3))
+        self._flush_feedback(max(budget - (time.perf_counter() - t0), 0.0))
+        if self._fsck_sched is not None:
+            self._fsck_sched.stop()
+        self.shutdown()
+
+    def _flush_feedback(self, timeout_s: float) -> None:
+        """Bounded wait for the feedback worker to clear its queue
+        (every drained serve may have enqueued a predict event)."""
+        if not self.config.feedback:
+            return
+        waiter = threading.Event()
+        end = time.perf_counter() + timeout_s
+        while (self._feedback_queue.unfinished_tasks
+               and time.perf_counter() < end):
+            waiter.wait(0.05)
+        if self._feedback_queue.unfinished_tasks:
+            _log.warning("stop_feedback_unflushed",
+                         remaining=self._feedback_queue.unfinished_tasks)
+
     # -- serving -------------------------------------------------------------
     def _serve_one(self, query_json: Any) -> Any:
         t0 = time.perf_counter()
@@ -656,6 +770,9 @@ class PredictionServer(HTTPServerBase):
                     reason="send_failed").inc()
                 self.obs_log.warning("feedback_dropped",
                                      reason="send failed", error=str(e))
+            finally:
+                # unfinished_tasks bookkeeping feeds the stop() flush
+                self._feedback_queue.task_done()
 
     # -- routes ---------------------------------------------------------------
     def _routes(self) -> None:
@@ -713,7 +830,8 @@ class PredictionServer(HTTPServerBase):
         @r.post("/stop")
         def stop(req: Request) -> Response:
             self.auth.check(req)
-            threading.Thread(target=self.shutdown, daemon=True).start()
+            # graceful: drain accepted work before the socket closes
+            threading.Thread(target=self.stop, daemon=True).start()
             return Response.json({"message": "Shutting down"})
 
         @r.get("/plugins.json")

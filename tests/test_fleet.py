@@ -1,0 +1,661 @@
+"""Fleet ops chaos suite: replicated model store + replica-set serving.
+
+Covers the PR-6 layer end-to-end the way an operator would hit it:
+
+  - quorum writes across N model-store replicas, including a partition
+    of one target mid-write (armed chaos fault) that the quorum absorbs
+  - envelope-level read-repair: a corrupt/missing replica is healed
+    from the first intact copy on the very read that detects it, and a
+    subsequent fsck comes back clean
+  - replica divergence (the silent damage a missed quorum write leaves
+    behind): detection by digest comparison and majority repair via
+    `pio doctor --repair`
+  - the fleet control plane: round-robin routing over admitted
+    replicas, a replica killed under live load costing ZERO failed
+    client requests, rolling /reload with the documented failure
+    policy (dead replica: continue on N-1; failed load: roll back and
+    abort), and graceful drain on stop
+  - the adaptive queue-delay shed and the scheduled background fsck /
+    quarantine GC satellites
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.data import fsck as fsck_mod
+from predictionio_tpu.data import integrity
+from predictionio_tpu.data.event import DataMap, Event, utcnow
+from predictionio_tpu.data.storage import AccessKey, App, StorageRegistry
+from predictionio_tpu.data.storage.base import (
+    EngineInstance, EngineInstanceStatus, Model, StorageError,
+)
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.resilience import OverloadedError, faults
+from predictionio_tpu.serving import (
+    FleetConfig, FleetServer, PredictionServer, ServerConfig,
+)
+from predictionio_tpu.serving.server import _MicroBatcher
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with the chaos harness disarmed."""
+    faults().clear()
+    yield
+    faults().clear()
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _metric(name, **labels):
+    return get_registry().value(name, **labels)
+
+
+# -- replicated model store --------------------------------------------------
+
+def _replicated_registry(tmp_path, replicas="R1,R2,R3", **extra):
+    cfg = {"PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+           "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+           "PIO_STORAGE_SOURCES_REP_TYPE": "REPLICATED",
+           "PIO_STORAGE_SOURCES_REP_REPLICAS": replicas,
+           "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REP"}
+    for name in ("R1", "R2", "R3"):
+        cfg[f"PIO_STORAGE_SOURCES_{name}_TYPE"] = "LOCALFS"
+        cfg[f"PIO_STORAGE_SOURCES_{name}_PATH"] = str(tmp_path / name.lower())
+        # fail fast when a test partitions a target
+        cfg[f"PIO_STORAGE_SOURCES_{name}_RETRY_ATTEMPTS"] = "1"
+    cfg.update(extra)
+    return StorageRegistry(cfg)
+
+
+def _blob(tmp_path, target, mid):
+    return tmp_path / target.lower() / f"pio_model_{mid}"
+
+
+def _corrupt(path):
+    """Flip the trailing byte, keeping the PIOB magic (a blob without
+    the magic gets the legacy pass-through, not a checksum failure)."""
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF
+    path.write_bytes(bytes(raw))
+
+
+class TestReplicatedStore:
+    def test_write_fans_out_to_every_replica(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        for t in ("R1", "R2", "R3"):
+            raw = _blob(tmp_path, t, "m1").read_bytes()
+            assert raw.startswith(integrity.BLOB_MAGIC)
+        assert models.get("m1").models == b"payload"
+        assert models.get("ghost") is None
+
+    def test_read_repair_heals_corrupt_replica(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        _corrupt(_blob(tmp_path, "R1", "m1"))
+        before = _metric("pio_model_repair_total", target="R1")
+        # the read that detects the damage serves from R2 AND heals R1
+        assert models.get("m1").models == b"payload"
+        assert _metric("pio_model_repair_total", target="R1") == before + 1
+        assert reg.get_data_object("R1", "Models").get("m1").models \
+            == b"payload"
+        # fsck after the repair finds nothing left to report
+        assert models.fsck(repair=False) == []
+        assert models.check_divergence(["m1"], repair=False) == []
+
+    def test_read_repair_restores_missing_replica(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        _blob(tmp_path, "R1", "m1").unlink()
+        assert models.get("m1").models == b"payload"
+        assert _blob(tmp_path, "R1", "m1").exists()
+
+    def test_every_replica_corrupt_raises_typed_error(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        for t in ("R1", "R2", "R3"):
+            _corrupt(_blob(tmp_path, t, "m1"))
+        with pytest.raises(integrity.CorruptBlobError):
+            models.get("m1")
+
+    def test_quorum_write_with_one_partitioned_target(self, tmp_path):
+        """The ISSUE chaos scenario: one target partitioned mid-write.
+        The quorum (2/3) still acks; after the partition heals, the
+        divergence sweep rewrites the missed replica."""
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        faults().arm("storage.R2.Models.insert", error=OSError)
+        models.insert(Model("m1", b"payload"))        # 2/3 acks: success
+        assert _blob(tmp_path, "R1", "m1").exists()
+        assert not _blob(tmp_path, "R2", "m1").exists()
+        assert _blob(tmp_path, "R3", "m1").exists()
+        assert models.get("m1").models == b"payload"
+        assert _metric("pio_replica_quorum_total",
+                       op="insert", outcome="ok") >= 1
+        faults().clear()                              # partition heals
+        findings = models.check_divergence(["m1"], repair=True)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["kind"] == "replica_divergence"
+        assert f["replicas"]["R2"] == "missing"
+        assert f["action"].startswith("rewrote R2")
+        assert _blob(tmp_path, "R2", "m1").exists()
+        assert models.check_divergence(["m1"], repair=False) == []
+
+    def test_write_below_quorum_raises(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        faults().arm("storage.R2.Models.insert", error=OSError)
+        faults().arm("storage.R3.Models.insert", error=OSError)
+        with pytest.raises(StorageError, match="quorum not met"):
+            models.insert(Model("m1", b"payload"))
+
+    def test_unreachable_target_is_skipped_never_written(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        _blob(tmp_path, "R1", "m1").unlink()
+        faults().arm("storage.R1.Models", error=OSError)   # R1 partitioned
+        assert models.get("m1").models == b"payload"
+        # repair needs positive evidence, not silence: nothing written
+        assert not _blob(tmp_path, "R1", "m1").exists()
+
+    def test_divergence_majority_repair(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        # silent divergence: R3 holds a VALID envelope of different bytes
+        _blob(tmp_path, "R3", "m1").write_bytes(integrity.wrap(b"stale"))
+        findings = models.check_divergence(["m1"], repair=True)
+        assert len(findings) == 1
+        assert findings[0]["action"].startswith("rewrote R3")
+        assert reg.get_data_object("R3", "Models").get("m1").models \
+            == b"payload"
+
+    def test_fsck_aggregates_per_target_findings(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        _corrupt(_blob(tmp_path, "R2", "m1"))
+        report = models.fsck(repair=False)
+        assert [f["target"] for f in report
+                if f["kind"] == "corrupt_blob"] == ["R2"]
+
+    def test_doctor_repairs_divergence(self, tmp_path):
+        """`pio-tpu doctor --repair` path: fsck_registry feeds instance
+        ids from the metadata store into the divergence sweep."""
+        reg = _replicated_registry(tmp_path)
+        instances = reg.get_meta_data_engine_instances()
+        t = utcnow()
+        iid = instances.insert(EngineInstance(
+            id="", status=EngineInstanceStatus.COMPLETED, start_time=t,
+            end_time=t, engine_id="default", engine_version="default",
+            engine_variant="default", engine_factory="f"))
+        models = reg.get_model_data_models()
+        models.insert(Model(iid, b"payload"))
+        _blob(tmp_path, "R2", iid).write_bytes(integrity.wrap(b"stale"))
+        report = fsck_mod.doctor(reg, repair=True)
+        div = [f for f in report["fsck"]
+               if f["kind"] == "replica_divergence"]
+        assert len(div) == 1 and div[0]["id"] == iid
+        assert div[0]["action"].startswith("rewrote R2")
+        assert reg.get_data_object("R2", "Models").get(iid).models \
+            == b"payload"
+
+    def test_config_validation(self, tmp_path):
+        with pytest.raises(StorageError, match=">= 2 target"):
+            _replicated_registry(
+                tmp_path, replicas="R1").get_model_data_models()
+        with pytest.raises(StorageError, match="unknown"):
+            _replicated_registry(
+                tmp_path, replicas="R1,NOPE").get_model_data_models()
+        with pytest.raises(StorageError, match="lists itself"):
+            _replicated_registry(
+                tmp_path, replicas="R1,REP").get_model_data_models()
+
+
+# -- scheduled fsck + quarantine GC ------------------------------------------
+
+def _fs_registry(tmp_path, **extra):
+    cfg = {"PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+           "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+           "PIO_STORAGE_SOURCES_FS_TYPE": "LOCALFS",
+           "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+           "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+           "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS"}
+    cfg.update(extra)
+    return StorageRegistry(cfg)
+
+
+class TestScheduledFsck:
+    def test_disabled_by_default(self, tmp_path):
+        assert fsck_mod.start_scheduled_fsck(_fs_registry(tmp_path)) is None
+        assert fsck_mod.start_scheduled_fsck(_fs_registry(
+            tmp_path, PIO_FSCK_INTERVAL_S="off")) is None
+
+    def test_background_pass_ticks_and_stamps_gauge(self, tmp_path):
+        reg = _fs_registry(tmp_path, PIO_FSCK_INTERVAL_S="0.05")
+        before = _metric("pio_fsck_runs_total", mode="report")
+        sched = fsck_mod.start_scheduled_fsck(reg)
+        assert sched is not None
+        try:
+            deadline = time.monotonic() + 5.0
+            while (_metric("pio_fsck_runs_total", mode="report")
+                   < before + 2 and time.monotonic() < deadline):
+                time.sleep(0.02)
+        finally:
+            sched.stop()
+        assert _metric("pio_fsck_runs_total", mode="report") >= before + 2
+        assert _metric("pio_fsck_last_run_ts") > 0
+
+    def test_quarantine_gc_purges_expired_blobs(self, tmp_path):
+        reg = _fs_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("ok", b"fine"))
+        bad = tmp_path / "models" / "pio_model_bad"
+        bad.write_bytes(integrity.wrap(b"x" * 64)[:-5])
+        models.fsck(repair=True)                      # -> quarantined
+        stats = models.quarantine_stats()
+        assert stats["count"] == 1 and stats["bytes"] > 0
+        # within retention: nothing purged
+        assert models.quarantine_gc(3600.0) == []
+        # age the quarantined pair past the window, then GC
+        qdir = tmp_path / "models" / ".quarantine"
+        old = utcnow().timestamp() - 7200
+        for f in qdir.iterdir():
+            os.utime(f, (old, old))
+        findings = fsck_mod.quarantine_gc(reg, retention_s=3600.0)
+        assert [f["kind"] for f in findings] == ["quarantine_expired"]
+        assert models.quarantine_stats() == {"bytes": 0.0, "count": 0.0}
+        assert _metric("pio_quarantine_bytes") == 0.0
+
+    def test_replicated_quarantine_aggregation(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        for t in ("R1", "R2"):
+            bad = tmp_path / t.lower() / "pio_model_bad"
+            bad.write_bytes(integrity.wrap(b"x" * 64)[:-5])
+        models.fsck(repair=True)
+        assert models.quarantine_stats()["count"] == 2
+        for t in ("r1", "r2"):
+            qdir = tmp_path / t / ".quarantine"
+            old = utcnow().timestamp() - 7200
+            for f in qdir.iterdir():
+                os.utime(f, (old, old))
+        findings = models.quarantine_gc(3600.0)
+        assert sorted(f["target"] for f in findings) == ["R1", "R2"]
+        assert models.quarantine_stats()["count"] == 0
+
+
+# -- adaptive queue-delay shedding -------------------------------------------
+
+class _StubDep:
+    def predict_batch(self, queries):
+        return list(queries)
+
+
+class TestAdaptiveShed:
+    def test_spike_sheds_only_while_pending(self):
+        b = _MicroBatcher(0.005, 8, queue_max=16, submit_timeout_s=0.05)
+        with b._lock:
+            b._delay_ewma = 1.0          # way over the 50ms budget
+            b._pending.append((None, None, threading.Event(), {}, 0.0))
+        with pytest.raises(OverloadedError) as ei:
+            b.submit(_StubDep(), {"q": 1})
+        assert "queue delay" in str(ei.value)
+        assert ei.value.retry_after > 0
+
+    def test_empty_queue_admits_despite_stale_spike(self):
+        """The self-correction property: with nothing pending the EWMA
+        spike must not shed (admitted traffic decays it)."""
+        b = _MicroBatcher(0.001, 4, submit_timeout_s=2.0)
+        with b._lock:
+            b._delay_ewma = 10.0
+        assert b.submit(_StubDep(), 7) == 7
+        assert 0 < b.queue_delay_ewma() < 10.0
+
+    def test_drain_observes_queue_delay(self):
+        b = _MicroBatcher(0.001, 4, submit_timeout_s=2.0)
+        assert b.submit(_StubDep(), 1) == 1
+        assert b.queue_delay_ewma() > 0.0
+        assert b.obs.queue_delay._default().count >= 1
+
+    def test_close_drains_then_sheds_then_reopens(self):
+        b = _MicroBatcher(0.001, 4, submit_timeout_s=2.0)
+        assert b.submit(_StubDep(), 1) == 1
+        assert b.close(timeout=1.0) is True
+        with pytest.raises(OverloadedError, match="draining"):
+            b.submit(_StubDep(), 2)
+        b.reopen()
+        assert b.submit(_StubDep(), 3) == 3
+
+
+# -- fleet control plane ------------------------------------------------------
+
+@pytest.fixture()
+def trained(mem_registry):
+    """Registry with a trained recommendation instance."""
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "fleetapp"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey("FKEY", app_id, ()))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="fleetapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4,
+                                           seed=1)),))
+    row = CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine, row, app_id
+
+
+def _start_fleet(trained, replicas=3, **fleet_kw):
+    registry, engine, _, _ = trained
+    fleet_kw.setdefault("health_interval_s", 0.1)
+    fleet_kw.setdefault("eject_threshold", 2)
+    fleet_kw.setdefault("drain_timeout_s", 2.0)
+    srv = FleetServer(ServerConfig(ip="127.0.0.1", port=0),
+                      FleetConfig(replicas=replicas, **fleet_kw),
+                      registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+class _Loader:
+    """Open-loop-ish client hammer; records every response status."""
+
+    def __init__(self, port, threads=2):
+        self.port = port
+        self.halt = threading.Event()
+        self.statuses = []
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(threads)]
+
+    def _run(self):
+        while not self.halt.is_set():
+            try:
+                status, _ = call(self.port, "POST", "/queries.json",
+                                 {"user": "u1", "num": 2})
+            except OSError:
+                status = -1              # fleet itself unreachable
+            with self._lock:
+                self.statuses.append(status)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    @property
+    def failures(self):
+        with self._lock:
+            return [s for s in self.statuses if s != 200]
+
+
+class TestFleet:
+    def test_routes_round_robin_over_admitted(self, trained):
+        fleet = _start_fleet(trained, replicas=3)
+        try:
+            for _ in range(6):
+                status, body = call(fleet.port, "POST", "/queries.json",
+                                    {"user": "u1", "num": 3})
+                assert status == 200 and len(body["itemScores"]) == 3
+            status, body = call(fleet.port, "GET", "/status.json")
+            assert status == 200 and body["role"] == "fleet"
+            assert len(body["replicas"]) == 3
+            assert all(r["admitted"] for r in body["replicas"])
+            # round-robin spread: every replica saw traffic
+            for rep in fleet._replicas:
+                s, b = call(rep.port, "GET", "/status.json")
+                assert s == 200 and b["requestCount"] == 2
+            status, _ = call(fleet.port, "GET", "/ready")
+            assert status == 200
+        finally:
+            fleet.stop()
+
+    def test_replica_killed_under_load_zero_failed_requests(self, trained):
+        """The ISSUE chaos scenario: a replica dies abruptly while
+        clients hammer the fleet and a rolling reload runs. The router
+        retries connection failures on the next replica, ejects the
+        corpse, and no client request fails."""
+        fleet = _start_fleet(trained, replicas=3)
+        try:
+            victim = fleet._replicas[0]
+            with _Loader(fleet.port) as load:
+                waiter = threading.Event()
+                waiter.wait(0.2)             # traffic flowing
+                victim.server.shutdown()     # abrupt death, no drain
+                status, report = call(fleet.port, "POST", "/reload")
+                waiter.wait(0.2)             # post-roll traffic
+            assert status == 200 and report["aborted"] is False
+            outcomes = {r["replica"]: r["outcome"]
+                        for r in report["results"]}
+            assert outcomes[0] == "skipped_dead"
+            assert outcomes[1] == "reloaded"
+            assert outcomes[2] == "reloaded"
+            # ZERO failed client requests through the whole episode
+            assert len(load.statuses) > 0
+            assert load.failures == []
+            # the corpse is out of rotation
+            assert victim.admitted is False
+            status, _ = call(fleet.port, "POST", "/queries.json",
+                             {"user": "u2", "num": 2})
+            assert status == 200
+        finally:
+            fleet.stop()
+
+    def test_rolling_reload_swaps_model_with_zero_downtime(self, trained):
+        registry, engine, row1, app_id = trained
+        fleet = _start_fleet(trained, replicas=3)
+        try:
+            # retrain -> a NEW completed instance the roll must pick up
+            ctx = RuntimeContext(registry=registry)
+            params = EngineParams(
+                data_source_params=(
+                    "", rec.DataSourceParams(app_name="fleetapp")),
+                algorithm_params_list=(
+                    ("als", rec.ALSAlgorithmParams(
+                        rank=4, num_iterations=4, seed=2)),))
+            row2 = CoreWorkflow.run_train(engine, params, ctx)
+            assert row2.id != row1.id
+            with _Loader(fleet.port) as load:
+                status, report = call(fleet.port, "POST", "/reload")
+            assert status == 200 and report["aborted"] is False
+            assert [r["outcome"] for r in report["results"]] \
+                == ["reloaded"] * 3
+            assert all(r["drained"] for r in report["results"])
+            assert len(load.statuses) > 0 and load.failures == []
+            for rep in fleet._replicas:
+                s, b = call(rep.port, "GET", "/status.json")
+                assert s == 200 and b["engineInstanceId"] == row2.id
+        finally:
+            fleet.stop()
+
+    def test_failed_load_rolls_back_and_aborts(self, trained):
+        """A replica whose reload 500s (load failure, last-good kept
+        serving) is re-admitted on the OLD model and the roll aborts —
+        the bad model must not be offered to the remaining replicas."""
+        fleet = _start_fleet(trained, replicas=3, health_interval_s=5.0)
+        try:
+            rep0 = fleet._replicas[0]
+
+            def broken_load(instance=None):
+                raise RuntimeError("model artifact unreadable")
+            rep0.server._load = broken_load
+            status, report = call(fleet.port, "POST", "/reload")
+            assert status == 500          # surfaced to the operator
+            assert report["aborted"] is True
+            assert len(report["results"]) == 1
+            assert report["results"][0]["outcome"] \
+                == "load_failed_rolled_back"
+            assert "unreadable" in report["results"][0]["detail"]
+            # re-admitted on the old model; fleet still serves
+            assert rep0.admitted is True
+            status, body = call(fleet.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200 and len(body["itemScores"]) == 2
+        finally:
+            fleet.stop()
+
+    def test_replica_dying_mid_reload_continues_on_remaining(self, trained):
+        fleet = _start_fleet(trained, replicas=3, health_interval_s=5.0)
+        try:
+            orig = fleet._reload_replica
+
+            def flaky(rep):
+                if rep.index == 1:
+                    return {"status": 0, "detail": "connection reset"}
+                return orig(rep)
+            fleet._reload_replica = flaky
+            report = fleet.rolling_reload()
+            assert report["aborted"] is False
+            outcomes = {r["replica"]: r["outcome"]
+                        for r in report["results"]}
+            assert outcomes == {0: "reloaded", 1: "died", 2: "reloaded"}
+            assert fleet._replicas[1].admitted is False
+            assert fleet._replicas[1].state == "dead"
+            status, _ = call(fleet.port, "POST", "/queries.json",
+                             {"user": "u1", "num": 2})
+            assert status == 200
+        finally:
+            fleet.stop()
+
+    def test_reload_replica_detects_transport_death(self, trained):
+        fleet = _start_fleet(trained, replicas=2, health_interval_s=5.0)
+        try:
+            rep = fleet._replicas[1]
+            rep.server.shutdown()
+            assert fleet._reload_replica(rep)["status"] == 0
+        finally:
+            fleet.stop()
+
+    def test_no_admitted_replica_sheds_503(self, trained):
+        fleet = _start_fleet(trained, replicas=2, health_interval_s=0.1)
+        try:
+            for rep in fleet._replicas:
+                with rep.lock:
+                    rep.admitted = False
+                    rep.state = "reloading"   # monitor keeps hands off
+            status, body = call(fleet.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 503
+            assert "no healthy replica" in body["message"]
+            status, _ = call(fleet.port, "GET", "/ready")
+            assert status == 503
+            # hand the replicas back to the monitor: it re-admits
+            for rep in fleet._replicas:
+                with rep.lock:
+                    rep.state = "ejected"
+            deadline = time.monotonic() + 5.0
+            status = 503
+            while status != 200 and time.monotonic() < deadline:
+                time.sleep(0.05)
+                status, _ = call(fleet.port, "POST", "/queries.json",
+                                 {"user": "u1", "num": 2})
+            assert status == 200
+        finally:
+            fleet.stop()
+
+
+# -- graceful stop (drain before socket close) --------------------------------
+
+class TestGracefulStop:
+    def test_stop_drains_inflight_batched_request(self, trained):
+        registry, engine, _, _ = trained
+        srv = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, batch_window_ms=5),
+            registry=registry, engine=engine)
+        srv.start()
+        gate = threading.Event()
+        dep = srv._dep
+        orig = dep.predict_batch
+
+        def slow(queries):
+            gate.wait(0.5)              # hold the drain mid-flight
+            return orig(queries)
+        dep.predict_batch = slow
+        results = []
+
+        def client():
+            results.append(call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2}))
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not srv._batcher._draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        srv.stop()                      # must wait for the accepted query
+        t.join(5)
+        assert results and results[0][0] == 200
+        assert results[0][1]["itemScores"]
+        assert srv._batcher._closed
+        assert not srv.is_running()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status.json", timeout=1)
+
+    def test_stop_endpoint_is_graceful_and_idempotent(self, trained):
+        registry, engine, _, _ = trained
+        srv = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, batch_window_ms=5),
+            registry=registry, engine=engine)
+        srv.start()
+        status, body = call(srv.port, "POST", "/stop")
+        assert status == 200 and "Shutting down" in body["message"]
+        deadline = time.monotonic() + 5.0
+        while srv.is_running() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not srv.is_running()
+        srv.stop()                      # second stop: no-op, no raise
