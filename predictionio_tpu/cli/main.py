@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve replicas behind the fleet control plane "
                         "(>1 enables health-gated routing + rolling "
                         "/reload)")
+    x.add_argument("--mesh",
+                   help="serving mesh spec (e.g. items=8): forces the "
+                        "mesh-sharded serve plan — item factors "
+                        "partitioned row-wise across the device mesh "
+                        "with on-device partial top-k + allgather merge")
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
@@ -276,6 +281,7 @@ def main(argv: Optional[list] = None) -> int:
                 event_server_port=args.event_server_port,
                 access_key=args.accesskey,
                 batch_window_ms=args.batch_window_ms,
+                mesh=args.mesh or "",
                 server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
             if args.replicas > 1:
                 server = FleetServer(

@@ -123,13 +123,20 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         jit'd program over all queries."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
-    def warm_serving(self, model: M, buckets: Sequence[int]) -> int:
+    def warm_serving(self, model: M, buckets: Sequence[int],
+                     mesh=None) -> int:
         """Deploy-time warmup hook: pin model state device-resident and
         AOT-compile the serve executables for the given batch-size
         `buckets`, so the first real request (and every one after) hits a
-        precompiled static shape. Returns the number of executables
-        compiled; the default is a no-op for host-only algorithms. Called
-        by `CoreWorkflow.prepare_deploy` after models are loaded."""
+        precompiled static shape. `mesh` (a `topk_sharded.ServeMesh`, or
+        None) is the candidate serving mesh: algorithms with sharding-
+        capable plans pass it to `serve_plan`/`similar_plan`, which
+        partition model state across the mesh when it is configured or
+        the catalog exceeds one device's capacity. Overrides that predate
+        the mesh parameter are still called (warm_deploy inspects the
+        signature). Returns the number of executables compiled; the
+        default is a no-op for host-only algorithms. Called by
+        `CoreWorkflow.prepare_deploy` after models are loaded."""
         return 0
 
 

@@ -236,19 +236,35 @@ class CoreWorkflow:
         models = deserialize_models(blob_row.models, instance.id, algos,
                                     ctx, retrain)
         if warm_batch_max is not None:
-            warm_deploy(algos, models, warm_batch_max)
+            # the serving mesh candidate: the engine-instance's recorded
+            # runtime_conf (training's device layout) merged with the
+            # server's own runtime_conf — a configured mesh in either
+            # forces the sharded serve path; otherwise plans shard only
+            # when the catalog exceeds one device's capacity
+            from predictionio_tpu.ops.topk_sharded import (
+                serve_mesh_from_conf,
+            )
+            conf = {**dict(getattr(instance, "runtime_conf", None) or {}),
+                    **dict(ctx.workflow_params.runtime_conf or {})}
+            warm_deploy(algos, models, warm_batch_max,
+                        mesh=serve_mesh_from_conf(conf))
         return algos, models, serving
 
 
 def warm_deploy(algos: List[Any], models: List[Any],
-                warm_batch_max: int) -> int:
+                warm_batch_max: int, mesh=None) -> int:
     """AOT-warm every algorithm's serve executables for the power-of-two
     batch buckets up to `warm_batch_max`, pinning model state device
-    resident, so steady-state serving never recompiles. Warmup cost/count
-    land in the default metrics registry (`pio_serve_warmup_seconds`,
-    `pio_serve_warmup_compiles_total`); `PIO_SERVE_WARMUP=off` disables.
-    A warmup failure is logged, never fatal — the generic dispatch paths
-    still serve correctly, just slower on first touch."""
+    resident, so steady-state serving never recompiles. `mesh` (a
+    `topk_sharded.ServeMesh` or None) is forwarded to every
+    `warm_serving` override that accepts it, so plans can shard model
+    state across the device mesh; legacy two-argument overrides keep
+    working. Warmup cost/count land in the default metrics registry
+    (`pio_serve_warmup_seconds`, `pio_serve_warmup_compiles_total`);
+    `PIO_SERVE_WARMUP=off` disables. A warmup failure is logged, never
+    fatal — the generic dispatch paths still serve correctly, just
+    slower on first touch."""
+    import inspect
     import os
     import time as _time
     if os.environ.get("PIO_SERVE_WARMUP", "on").lower() in (
@@ -269,7 +285,15 @@ def warm_deploy(algos: List[Any], models: List[Any],
     for algo, model in zip(algos, models):
         label = type(algo).__name__
         try:
-            n = algo.warm_serving(model, buckets)
+            try:
+                params = inspect.signature(algo.warm_serving).parameters
+                takes_mesh = ("mesh" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()))
+            except (TypeError, ValueError):
+                takes_mesh = False
+            n = (algo.warm_serving(model, buckets, mesh=mesh)
+                 if takes_mesh else algo.warm_serving(model, buckets))
             compiled += int(n or 0)
         except Exception as e:
             _log.warning("serve_warmup_failed", algo=label,
@@ -282,6 +306,7 @@ def warm_deploy(algos: List[Any], models: List[Any],
             "pio_serve_warmup_compiles_total",
             "Serve executables AOT-compiled at deploy warmup").inc(compiled)
     _log.info("serve_warmup", buckets=buckets, compiled=compiled,
+              shards=(mesh.n_shards if mesh is not None else 0),
               seconds=round(_time.perf_counter() - t0, 3))
     return compiled
 

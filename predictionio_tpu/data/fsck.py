@@ -118,17 +118,24 @@ def fsck_registry(registry, repair: bool = False) -> List[dict]:
 
 
 def _check_divergence(registry, models_dao, repair: bool) -> List[dict]:
-    """Replica-divergence sweep (REPLICATED model source only): model
-    blobs are keyed by engine-instance id, so the id universe comes
-    from the metadata store — the localfs filename escaping is lossy,
-    which rules out enumerating the store itself."""
+    """Replica-divergence sweep (REPLICATED model source only). The id
+    universe is metadata-derived instance ids UNION the store's own
+    enumerable ids (`Models.list_model_ids`) — a blob whose instance
+    row was deleted, or that only a subset of replicas holds, is
+    invisible to the metadata store yet is exactly the divergence the
+    sweep exists to catch. Instance ids are alphanumeric, so the lossy
+    localfs filename escape is the identity for every id the system
+    writes."""
     check = getattr(models_dao, "check_divergence", None)
     if check is None:
         return []
     try:
-        ids = [row.id for row in
-               registry.get_meta_data_engine_instances().get_all()]
-        return check(ids, repair=repair) if ids else []
+        ids = {row.id for row in
+               registry.get_meta_data_engine_instances().get_all()}
+        lister = getattr(models_dao, "list_model_ids", None)
+        if lister is not None:
+            ids.update(lister())
+        return check(sorted(ids), repair=repair) if ids else []
     except (StorageError, OSError) as exc:
         return [{"kind": "fsck_error", "repo": "models",
                  "reason": f"divergence check failed: {exc}",

@@ -310,6 +310,15 @@ class Models(abc.ABC):
     @abc.abstractmethod
     def delete(self, mid: str) -> None: ...
 
+    def list_model_ids(self) -> List[str]:
+        """Store-enumerable model ids, sorted. Default: the driver
+        cannot enumerate (object stores without listing, etc.) — the
+        fsck/doctor sweeps then fall back to metadata-derived ids
+        alone. Drivers with lossy key escaping (localfs) return the
+        ESCAPED names; instance ids are alphanumeric so the escape is
+        the identity for every id the system itself writes."""
+        return []
+
 
 # ---------------------------------------------------------------------------
 # Event store

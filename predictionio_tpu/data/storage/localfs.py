@@ -67,6 +67,13 @@ class LocalFSModels(base.Models):
             f.unlink()
         integrity.purge_tmp_siblings(f)
 
+    def list_model_ids(self) -> List[str]:
+        """Ids derived from the `pio_model_*` filenames (the escape in
+        `_file` is lossy for non-alnum ids — see base.Models)."""
+        return sorted(
+            f.name[len("pio_model_"):] for f in self.c.path.glob("pio_model_*")
+            if not f.name.endswith(".tmp"))
+
     def fsck(self, repair: bool = False) -> List[dict]:
         """Scan all blobs; quarantine corrupt ones and purge orphaned
         tmp files when `repair` is set. Returns finding dicts."""

@@ -219,6 +219,10 @@ class MemModels(base.Models):
         with self.c.lock:
             self.c.models.pop(mid, None)
 
+    def list_model_ids(self) -> List[str]:
+        with self.c.lock:
+            return sorted(self.c.models)
+
 
 class MemEvents(base.EventStore):
     def __init__(self, client: MemStorageClient):

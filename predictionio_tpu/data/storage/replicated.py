@@ -10,11 +10,15 @@ Models store fanning out over N *other* configured sources:
 
 Semantics:
 
-  - `insert`/`delete` fan out to every target and ack once a QUORUM of
-    targets succeeded (each target is independently wrapped in the
-    registry's resilience proxy, so per-target retry schedules, retry
-    budgets, and circuit breakers from PR-2/PR-3 apply before a target
-    counts as failed). Fewer acks than quorum raises StorageError.
+  - `insert`/`delete` fan out to every target CONCURRENTLY (one worker
+    per target) and ack once a QUORUM of targets succeeded — write
+    latency tracks the quorum-th fastest target, not the sum of all
+    targets; stragglers finish in the background so healthy-but-slow
+    replicas still converge. Each target is independently wrapped in
+    the registry's resilience proxy, so per-target retry schedules,
+    retry budgets, and circuit breakers from PR-2/PR-3 apply before a
+    target counts as failed. Fewer acks than quorum raises
+    StorageError.
   - `get` reads targets in configured order and returns the first
     INTACT copy (the PR-3 envelope checksum is the arbiter). A replica
     that was corrupt (`CorruptBlobError`) or missing the blob is
@@ -40,6 +44,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # module (not name) import: integrity itself imports storage.base, so
@@ -124,6 +129,7 @@ class ReplicatedModels(base.Models):
         self.c = client
         self._lock = threading.Lock()
         self._daos: Optional[List[Tuple[str, base.Models]]] = None
+        self._inflight: List = []    # straggler writes past quorum ack
         self._m = _metrics()
 
     def _targets(self) -> List[Tuple[str, base.Models]]:
@@ -138,27 +144,74 @@ class ReplicatedModels(base.Models):
 
     # -- writes -------------------------------------------------------------
     def _fan_out(self, op: str, fn) -> None:
-        acks, failures = 0, []
-        for name, dao in self._targets():
+        """Fan the write out to every target CONCURRENTLY and ack as
+        soon as a QUORUM succeeded — write latency is the quorum-th
+        fastest target (bounded by max(target)), not sum(target) as the
+        old serial loop was. Stragglers keep running after the ack so
+        slow-but-healthy replicas still converge; their per-target
+        metrics and failure logs land when they finish. Each worker
+        calls the target through its own resilience proxy, so
+        per-target retry schedules, budgets, and breakers are exactly
+        what they were under the serial loop."""
+        targets = self._targets()
+        n = len(targets)
+        cond = threading.Condition()
+        state = {"acks": 0, "done": 0}
+        failures: List[Tuple[str, Exception]] = []
+
+        def run(name: str, dao: base.Models) -> None:
             try:
                 fn(dao)
-                acks += 1
-                self._m["writes"].labels(target=name, outcome="ok").inc()
             except Exception as e:
-                failures.append((name, e))
                 self._m["writes"].labels(target=name,
                                          outcome="failed").inc()
                 _log.warning("replica_write_failed", op=op, target=name,
                              error=f"{type(e).__name__}: {e}")
+                with cond:
+                    failures.append((name, e))
+                    state["done"] += 1
+                    cond.notify_all()
+                return
+            self._m["writes"].labels(target=name, outcome="ok").inc()
+            with cond:
+                state["acks"] += 1
+                state["done"] += 1
+                cond.notify_all()
+
+        pool = ThreadPoolExecutor(max_workers=n,
+                                  thread_name_prefix=f"replica-{op}")
+        try:
+            futs = [pool.submit(run, name, dao) for name, dao in targets]
+            with self._lock:
+                self._inflight = [f for f in self._inflight
+                                  if not f.done()] + futs
+            with cond:
+                while state["acks"] < self.c.quorum and state["done"] < n:
+                    cond.wait(timeout=0.5)
+                acks = state["acks"]
+                detail = "; ".join(f"{name}: {type(e).__name__}: {e}"
+                                   for name, e in failures)
+        finally:
+            # no wait: an early quorum ack must not join stragglers
+            pool.shutdown(wait=False)
         if acks < self.c.quorum:
             self._m["quorum"].labels(op=op, outcome="failed").inc()
-            detail = "; ".join(f"{n}: {type(e).__name__}: {e}"
-                               for n, e in failures)
             raise StorageError(
                 f"replicated {op}: quorum not met "
                 f"({acks}/{self.c.quorum} of {len(self.c.targets)} "
                 f"targets acked; failures: {detail})")
         self._m["quorum"].labels(op=op, outcome="ok").inc()
+
+    def _drain(self, timeout_s: float = 30.0) -> None:
+        """Join straggler replica writes from earlier quorum-acked
+        fan-outs (deterministic sequencing for tests and shutdown)."""
+        with self._lock:
+            pending, self._inflight = self._inflight, []
+        for f in pending:
+            try:
+                f.result(timeout=timeout_s)
+            except Exception:
+                pass    # the worker already logged and counted it
 
     def insert(self, m: Model) -> None:
         self._fan_out("insert", lambda dao: dao.insert(m))
@@ -216,6 +269,23 @@ class ReplicatedModels(base.Models):
                 continue
             self._m["repair"].labels(target=name).inc()
             _log.warning("read_repair", id=mid, target=name, was=reason)
+
+    def list_model_ids(self) -> List[str]:
+        """Union of every reachable target's enumerable ids — a blob a
+        quorum write missed on some replica still shows up as long as
+        ONE replica holds it (that asymmetry is exactly what the
+        divergence sweep wants to examine)."""
+        ids: set = set()
+        for name, dao in self._targets():
+            lister = getattr(dao, "list_model_ids", None)
+            if lister is None:
+                continue
+            try:
+                ids.update(lister())
+            except (StorageError, OSError) as e:
+                _log.warning("list_model_ids_failed", target=name,
+                             error=f"{type(e).__name__}: {e}")
+        return sorted(ids)
 
     # -- fsck / divergence ---------------------------------------------------
     def fsck(self, repair: bool = False) -> List[dict]:

@@ -423,6 +423,12 @@ class SQLiteModels(base.Models):
         with self.c.lock, self.c.conn:
             self.c.conn.execute("DELETE FROM models WHERE id=?", (mid,))
 
+    def list_model_ids(self) -> List[str]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id FROM models ORDER BY id").fetchall()
+        return [r[0] for r in rows]
+
     def fsck(self, repair: bool = False) -> List[dict]:
         from predictionio_tpu.data import integrity
         findings: List[dict] = []

@@ -32,8 +32,7 @@ from predictionio_tpu.data import store
 from predictionio_tpu.ingest import BiMap, RatingColumns
 from predictionio_tpu.ops import als
 from predictionio_tpu.ops.topk import (
-    NEG_INF, BucketedTopK, _next_pow2, topk_scores, topk_scores_filtered,
-    topk_similar,
+    NEG_INF, _next_pow2, topk_scores, topk_scores_filtered, topk_similar,
 )
 
 
@@ -243,17 +242,21 @@ class ECommAlgorithm(Algorithm):
                  for s, ix in zip(scores, ixs) if s > NEG_INF / 2]
         return PredictedResult(tuple(items))
 
-    def warm_serving(self, model: ECommModel, buckets) -> int:
+    def warm_serving(self, model: ECommModel, buckets,
+                     mesh=None) -> int:
         """Build the deploy-time serving plan: item factors pinned device
         resident, one AOT executable per batch bucket, banned width sized
         to the CURRENT unavailableItems constraint plus headroom for
-        per-user seen/blackList indices."""
+        per-user seen/blackList indices. A configured serving mesh (or an
+        over-capacity catalog) shards the factors row-wise
+        (`ShardedBucketedTopK`); banned ids stay global either way."""
+        from predictionio_tpu.ops.topk_sharded import serve_plan
         ctx = getattr(self, "_serving_ctx", None)
         n_unavail = len(self._unavailable_items(ctx)) if ctx else 0
         width = _next_pow2(max(256, n_unavail + 128))
-        self._serve_plan = BucketedTopK(
+        self._serve_plan = serve_plan(
             model.item_factors, k=Query().num, buckets=buckets,
-            banned_width=width)
+            banned_width=width, mesh=mesh)
         return self._serve_plan.warm()
 
     def batch_predict(self, model, queries):

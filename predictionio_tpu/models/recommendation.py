@@ -30,7 +30,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.ingest import RatingColumns
 from predictionio_tpu.ops import als
-from predictionio_tpu.ops.topk import (NEG_INF, BucketedTopK, topk_scores,
+from predictionio_tpu.ops.topk import (NEG_INF, topk_scores,
                                        topk_scores_filtered)
 
 
@@ -153,13 +153,18 @@ class ALSAlgorithm(Algorithm):
     def predict(self, model: als.ALSModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
-    def warm_serving(self, model: als.ALSModel, buckets) -> int:
+    def warm_serving(self, model: als.ALSModel, buckets,
+                     mesh=None) -> int:
         """Deploy warmup: pin item factors device-resident and AOT-compile
         the per-bucket banned-index executables (blackList queries are the
-        common case; whiteList queries use the dense-mask path)."""
-        self._serve_plan = BucketedTopK(
+        common case; whiteList queries use the dense-mask path). With a
+        configured serving mesh — or a catalog past one device's capacity
+        — the plan shards the factors row-wise across the mesh
+        (`ShardedBucketedTopK`)."""
+        from predictionio_tpu.ops.topk_sharded import serve_plan
+        self._serve_plan = serve_plan(
             model.item_factors, k=Query(user="").num, buckets=buckets,
-            banned_width=64)
+            banned_width=64, mesh=mesh)
         return self._serve_plan.warm()
 
     def batch_predict(self, model: als.ALSModel,

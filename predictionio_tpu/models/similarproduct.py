@@ -128,13 +128,17 @@ class _FactorSimilarityAlgorithm(Algorithm):
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
-    def warm_serving(self, model: SimilarModel, buckets) -> int:
+    def warm_serving(self, model: SimilarModel, buckets,
+                     mesh=None) -> int:
         """Deploy warmup: pin item factors device-resident and
         AOT-compile the per-bucket cosine-top-k executables, so the
-        dense-mask serve path never consults the jit tracing cache."""
-        from predictionio_tpu.ops.topk import BucketedSimilar
-        self._serve_plan = BucketedSimilar(
-            model.item_factors, k=Query().num, buckets=buckets)
+        dense-mask serve path never consults the jit tracing cache.
+        A configured serving mesh (or an over-capacity catalog) shards
+        the factors row-wise (`ShardedBucketedSimilar`)."""
+        from predictionio_tpu.ops.topk_sharded import similar_plan
+        self._serve_plan = similar_plan(
+            model.item_factors, k=Query().num, buckets=buckets,
+            mesh=mesh)
         return self._serve_plan.warm()
 
     def batch_predict(self, model: SimilarModel,

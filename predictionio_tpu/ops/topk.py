@@ -31,8 +31,14 @@ Two dispatch refinements on top of the static size rule:
     tracing cache), so steady-state serving is zero-recompile by
     construction.
 
-Every dispatch lands in `pio_topk_dispatch_total{path=host|device}` (the
-process-default metrics registry) and in `DISPATCH_COUNTS`.
+A third path lives in `ops/topk_sharded.py`: `ShardedBucketedTopK` /
+`ShardedBucketedSimilar` partition the catalog row-wise across a device
+mesh (per-shard partial top-k + allgather merge) when a mesh is
+configured or the catalog exceeds one device's capacity.
+
+Every dispatch lands in `pio_topk_dispatch_total{path=host|device|
+sharded}` (the process-default metrics registry) and in
+`DISPATCH_COUNTS`; the `DispatchPolicy` keeps a latency EWMA per path.
 """
 
 from __future__ import annotations
@@ -65,7 +71,7 @@ HOST_CROSSOVER_CELLS = int(_os.environ.get(
 # program). Read by the bench to PROVE the device path ran, and by tests;
 # plain ints under the GIL (worst case a lost increment, never a wrong
 # path).
-DISPATCH_COUNTS = {"host": 0, "device": 0}
+DISPATCH_COUNTS = {"host": 0, "device": 0, "sharded": 0}
 
 # Below this many score cells the amortized policy never promotes to the
 # device, whatever the EWMAs say: tiny unit-test-sized problems must stay
@@ -86,8 +92,9 @@ def _dispatch_total():
         from predictionio_tpu.obs import get_registry
         _DISPATCH_TOTAL = get_registry().counter(
             "pio_topk_dispatch_total",
-            "Top-k serve dispatches by path taken (host BLAS vs device "
-            "program; traced calls count as device)", labels=("path",))
+            "Top-k serve dispatches by path taken (host BLAS, "
+            "single-device program, or mesh-sharded program; traced "
+            "calls count as device)", labels=("path",))
     return _DISPATCH_TOTAL
 
 
@@ -118,6 +125,11 @@ class DispatchPolicy:
         self._lock = threading.Lock()
         self._host_s_per_cell: Optional[float] = None
         self._device_call_s: Optional[float] = None
+        # the mesh-sharded plan's per-call EWMA: observed so operators
+        # (and the persisted snapshot) see all three paths' latency,
+        # even though a warmed sharded plan is dispatched whenever the
+        # batch fits it (mirroring the single-device plan)
+        self._sharded_call_s: Optional[float] = None
         self._host_inflight = 0
 
     def choose(self, cells: int) -> str:
@@ -149,6 +161,10 @@ class DispatchPolicy:
                 prev = self._host_s_per_cell
                 self._host_s_per_cell = (per_cell if prev is None
                                          else prev + a * (per_cell - prev))
+            elif path == "sharded":
+                prev = self._sharded_call_s
+                self._sharded_call_s = (seconds if prev is None
+                                        else prev + a * (seconds - prev))
             else:
                 prev = self._device_call_s
                 self._device_call_s = (seconds if prev is None
@@ -158,6 +174,7 @@ class DispatchPolicy:
         with self._lock:
             return {"host_s_per_cell": self._host_s_per_cell,
                     "device_call_s": self._device_call_s,
+                    "sharded_call_s": self._sharded_call_s,
                     "host_inflight": self._host_inflight}
 
     def restore(self, state: dict) -> None:
@@ -168,10 +185,13 @@ class DispatchPolicy:
         with self._lock:
             h = state.get("host_s_per_cell")
             d = state.get("device_call_s")
+            s = state.get("sharded_call_s")
             if isinstance(h, (int, float)) and h > 0:
                 self._host_s_per_cell = float(h)   # lint: ok — host JSON
             if isinstance(d, (int, float)) and d > 0:
                 self._device_call_s = float(d)     # lint: ok — host JSON
+            if isinstance(s, (int, float)) and s > 0:
+                self._sharded_call_s = float(s)    # lint: ok — host JSON
 
 
 DISPATCH_POLICY = DispatchPolicy()

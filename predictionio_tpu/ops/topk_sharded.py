@@ -1,0 +1,421 @@
+"""Mesh-sharded serving plans: partial top-k per shard + allgather merge.
+
+A catalog bigger than one chip's HBM cannot be pinned by `BucketedTopK`
+— and `MULTICHIP_r0*.json` shows every model's train step already runs
+on 8-device meshes while serving ignored the mesh entirely. This module
+closes that gap with the sharded-scoring shape "Scalable ML Training
+Infrastructure at Google" describes for ads scoring: partition the
+embedding (factor) table row-wise, score locally, merge partial top-k.
+
+`ShardedBucketedTopK` / `ShardedBucketedSimilar` are drop-in serving
+plans (same `warm()/fits()/__call__` contract as their single-device
+counterparts in `ops/topk.py`):
+
+  - item factors are padded to a multiple of the shard count and
+    device_put ONCE with a row sharding over the serve mesh's "items"
+    axis (`parallel.mesh.shard_put`), so each device holds an
+    `n_items/n_shards` slice of the catalog for the plan's lifetime;
+  - every batch bucket is AOT-lowered/compiled against that resident
+    sharded array: inside the program each shard computes its local
+    score block (one matmul at `Precision.HIGHEST`, identical math to
+    the single-device path), applies banned-index filtering IN GLOBAL
+    ID SPACE (banned ids arrive untranslated; each shard subtracts its
+    row base, routes out-of-shard ids to an out-of-bounds slot, and the
+    scatter drops them), masks its padding
+    rows to NEG_INF, takes a LOCAL `lax.top_k`, then all-gathers the
+    `k_shard * n_shards` candidates and merges them with a final
+    top-k over globally-offset ids;
+  - the merge is bit-identical to the single-device oracle, ties
+    included: candidates concatenate in shard-major order (= global id
+    order for equal scores, since `lax.top_k` is lowest-index-first
+    within a shard), so the final top-k's positional tie-break
+    reproduces the full-matrix `lax.top_k` exactly. Survival argument:
+    any item in the global top-k has fewer than k items above it
+    globally, hence fewer within its own shard, hence it is inside the
+    shard's top-`min(k, per_shard)` candidates.
+
+Path selection (`serve_plan`/`similar_plan` + `serve_mesh_from_conf`):
+sharding engages when a mesh is explicitly configured (a `mesh` key in
+the engine-instance/server runtime_conf, or `PIO_SERVE_SHARD=on`) or
+when — under the default `PIO_SERVE_SHARD=auto` — the factor matrix
+exceeds a single device's capacity (`PIO_DEVICE_HBM_BYTES` override,
+else the backend's reported bytes_limit; unknown capacity, e.g. host
+CPU, never auto-shards). `PIO_SERVE_SHARD=off` disables entirely and
+`PIO_SERVE_SHARDS` caps the shard count.
+
+Every sharded dispatch lands in `pio_topk_dispatch_total{path=
+"sharded"}` and `DISPATCH_COUNTS["sharded"]`, and feeds the
+`DispatchPolicy` sharded-path EWMA; plan construction publishes
+`pio_serve_shards` and per-shard `pio_serve_shard_bytes{shard=...}`
+HBM-residency gauges.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops import compat
+from predictionio_tpu.ops.topk import (
+    DEFAULT_SERVE_BUCKETS, NEG_INF, BucketedSimilar, BucketedTopK,
+    _next_pow2, _record_dispatch,
+)
+from predictionio_tpu.parallel.mesh import shard_put
+
+# the serve mesh's single axis: catalog rows are partitioned over it
+SHARD_AXIS = "items"
+
+
+@dataclass(frozen=True)
+class ServeMesh:
+    """A serving mesh plus HOW it was chosen: `forced` means sharding
+    was explicitly configured (runtime_conf mesh / PIO_SERVE_SHARD=on)
+    and engages regardless of catalog size; un-forced meshes only shard
+    catalogs that exceed one device's capacity."""
+    mesh: "jax.sharding.Mesh"
+    forced: bool = False
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[SHARD_AXIS])  # lint: ok — host meta
+
+
+def serve_mesh_from_conf(conf=None) -> Optional[ServeMesh]:
+    """The deploy-time serving mesh: the "items" axis over the local
+    devices, or None when sharded serving is off or pointless (< 2
+    devices). `conf` is the merged engine-instance + server
+    runtime_conf; a configured training mesh there forces the sharded
+    path (training and serving agree on the device layout)."""
+    mode = (os.environ.get("PIO_SERVE_SHARD", "auto") or "auto").lower()
+    if mode in ("off", "0", "false"):
+        return None
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    want = int(os.environ.get("PIO_SERVE_SHARDS", "0") or 0)  # lint: ok
+    n = min(want, len(devices)) if want > 0 else len(devices)
+    if n < 2:
+        return None
+    forced = mode in ("on", "1", "true") or bool((conf or {}).get("mesh"))
+    return ServeMesh(Mesh(np.array(devices[:n]),  # lint: ok — host list
+                          (SHARD_AXIS,)), forced)
+
+
+def device_capacity_bytes() -> Optional[float]:
+    """Per-device HBM capacity for the fits-one-device check:
+    `PIO_DEVICE_HBM_BYTES` wins, else the backend's reported
+    bytes_limit, else None (unknown — host CPU backends report
+    nothing, and an unknown capacity never auto-shards)."""
+    env = os.environ.get("PIO_DEVICE_HBM_BYTES", "").strip()
+    if env:
+        return float(env)   # lint: ok — host env knob
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        return float(limit) if limit else None  # lint: ok — host stat
+    except Exception:
+        return None
+
+
+def _wants_shard(n_items: int, rank: int,
+                 mesh: Optional[ServeMesh]) -> bool:
+    """Whether `serve_plan` should build the sharded plan: a usable
+    mesh AND (explicitly configured, or the factor matrix does not fit
+    one device — `BucketedTopK.fits`-style capacity check, with 20%
+    headroom for the score/workspace buffers)."""
+    if mesh is None or mesh.n_shards < 2:
+        return False
+    if mesh.forced:
+        return True
+    cap = device_capacity_bytes()
+    if cap is None:
+        return False
+    return n_items * rank * 4 > cap * 0.8
+
+
+def serve_plan(item_factors, *, k: int,
+               buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+               banned_width: int = 256,
+               mesh: Optional[ServeMesh] = None):
+    """The banned-index serving plan for this deployment: sharded when
+    the mesh warrants it (see `_wants_shard`), else the single-device
+    `BucketedTopK`. Both satisfy the same warm/fits/__call__ contract."""
+    n_items, rank = np.asarray(item_factors).shape  # lint: ok — host meta
+    if _wants_shard(n_items, rank, mesh):
+        return ShardedBucketedTopK(item_factors, k=k, buckets=buckets,
+                                   banned_width=banned_width,
+                                   mesh=mesh.mesh)
+    return BucketedTopK(item_factors, k=k, buckets=buckets,
+                        banned_width=banned_width)
+
+
+def similar_plan(item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+                 mesh: Optional[ServeMesh] = None):
+    """The dense-mask cosine serving plan: sharded or single-device by
+    the same selection rule as `serve_plan`."""
+    n_items, rank = np.asarray(item_factors).shape  # lint: ok — host meta
+    if _wants_shard(n_items, rank, mesh):
+        return ShardedBucketedSimilar(item_factors, k=k, buckets=buckets,
+                                      mesh=mesh.mesh)
+    return BucketedSimilar(item_factors, k=k, buckets=buckets)
+
+
+def _publish_shard_gauges(n_shards: int, per_shard: int,
+                          rank: int) -> None:
+    """Shard-count + per-shard HBM residency gauges; metrics must never
+    fail a deploy."""
+    try:
+        from predictionio_tpu.obs import get_registry
+        reg = get_registry()
+        reg.gauge("pio_serve_shards",
+                  "Shard count of the current sharded serving plan "
+                  "(0/absent = single-device)").set(
+                      float(n_shards))  # lint: ok — host int
+        g = reg.gauge("pio_serve_shard_bytes",
+                      "Resident factor bytes pinned per shard by the "
+                      "sharded serving plan", labels=("shard",))
+        for s in range(n_shards):
+            g.labels(shard=str(s)).set(float(per_shard * rank * 4))
+    except Exception:
+        pass
+
+
+class _ShardedPlanBase:
+    """Shared bucketing/pad/chunk mechanics of the two sharded plans."""
+
+    def __init__(self, item_factors, *, k: int, buckets: Sequence[int],
+                 mesh):
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        self.n_items, self.rank = host.shape
+        self.k = max(1, min(k, self.n_items))
+        self.buckets = tuple(sorted({_next_pow2(b)
+                                     for b in buckets if b > 0})) or (1,)
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[SHARD_AXIS])  # lint: ok — host
+        # row-shard the (zero-padded) factors across the mesh ONCE; the
+        # sharded array is the plan's resident model state
+        self.factors, _ = shard_put(host, mesh, SHARD_AXIS)
+        self.n_pad = int(self.factors.shape[0])  # lint: ok — shape meta
+        self.per_shard = self.n_pad // self.n_shards
+        # per-shard candidate count: a shard can never contribute more
+        # rows than it holds (k > per_shard clamps, the merge still
+        # sees >= k real candidates overall)
+        self.k_shard = min(self.k, self.per_shard)
+        self._exe: dict = {}
+        _publish_shard_gauges(self.n_shards, self.per_shard, self.rank)
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def _bucket_for(self, b: int) -> int:
+        for bucket in self.buckets:
+            if bucket >= b:
+                return bucket
+        return self.max_bucket
+
+    def _require_exe(self, bucket: int):
+        exe = self._exe.get(bucket)
+        if exe is None:
+            raise RuntimeError(
+                f"{type(self).__name__} bucket {bucket} not warmed; "
+                "call warm() at deploy time")
+        return exe
+
+
+class ShardedBucketedTopK(_ShardedPlanBase):
+    """Banned-index top-k over a row-sharded resident factor matrix:
+    per-shard partial top-k on-device, allgather + merge to the global
+    top-k (module docstring has the full program shape and the
+    tie-parity argument). Drop-in for `BucketedTopK`."""
+
+    def __init__(self, item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+                 banned_width: int = 256, mesh=None):
+        super().__init__(item_factors, k=k, buckets=buckets, mesh=mesh)
+        self.banned_width = _next_pow2(max(1, banned_width))
+        self._fn = self._build()
+
+    def _build(self):
+        from jax.sharding import PartitionSpec as P
+        per, n_items, kk, k = (self.per_shard, self.n_items,
+                               self.k_shard, self.k)
+
+        def body(vecs, factors_local, banned):
+            # vecs [b, rank] + banned [b, W] replicated; factors_local
+            # [per_shard, rank] is this shard's catalog slice
+            base = jax.lax.axis_index(SHARD_AXIS) * per
+            scores = jnp.matmul(vecs, factors_local.T,
+                                precision=jax.lax.Precision.HIGHEST)
+            rows = jnp.arange(scores.shape[0])[:, None]
+            # banned ids are GLOBAL: translate to this shard's local
+            # columns. Out-of-shard ids (and the n_items filler) must be
+            # routed to an explicitly out-of-bounds slot BEFORE the
+            # scatter — `.at[]` wraps negative indices NumPy-style even
+            # under mode="drop", so a bare `banned - base` would make a
+            # banned id g also ban g + per_shard on the next shard.
+            loc = banned - base
+            loc = jnp.where((loc >= 0) & (loc < per), loc, per)
+            scores = scores.at[rows, loc].set(NEG_INF, mode="drop")
+            gids = base + jnp.arange(per)
+            scores = jnp.where(gids[None, :] < n_items, scores, NEG_INF)
+            s, ix = jax.lax.top_k(scores, kk)
+            s_all = jax.lax.all_gather(s, SHARD_AXIS)
+            g_all = jax.lax.all_gather(ix + base, SHARD_AXIS)
+            # shard-major concatenation = global-id order for ties
+            s_cat = jnp.swapaxes(s_all, 0, 1).reshape(s.shape[0], -1)
+            g_cat = jnp.swapaxes(g_all, 0, 1).reshape(s.shape[0], -1)
+            sv, si = jax.lax.top_k(s_cat, k)
+            return sv, jnp.take_along_axis(g_cat, si, axis=1)
+
+        smapped = compat.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(SHARD_AXIS, None), P()),
+            out_specs=(P(), P()))
+        if jax.default_backend() == "cpu":
+            return jax.jit(smapped)
+        # off-CPU: donate the per-call query + banned uploads, exactly
+        # as the single-device plan does
+        return jax.jit(smapped, donate_argnums=(0, 2))
+
+    def warm(self) -> int:
+        """AOT-lower/compile every bucket executable against the
+        resident sharded factors (idempotent)."""
+        compiled = 0
+        for b in self.buckets:
+            if b in self._exe:
+                continue
+            vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
+            ban_spec = jax.ShapeDtypeStruct((b, self.banned_width),
+                                            np.int32)
+            self._exe[b] = self._fn.lower(vec_spec, self.factors,
+                                          ban_spec).compile()
+            compiled += 1
+        return compiled
+
+    def fits(self, *, max_banned: int, k: int) -> bool:
+        """Same gate as `BucketedTopK.fits`."""
+        return (bool(self._exe)
+                and k <= self.k and max_banned <= self.banned_width)
+
+    def __call__(self, user_vecs, banned_lists: Sequence[Sequence[int]]):
+        """Score [b, rank] queries against the sharded catalog with
+        per-row GLOBAL banned-id lists; returns host (scores [b, k],
+        ids [b, k]). Pads to the bucket grid; chunks past the biggest
+        bucket."""
+        user_vecs = np.asarray(user_vecs, np.float32)  # lint: ok — host in
+        b = user_vecs.shape[0]
+        if b > self.max_bucket:
+            parts = [self(user_vecs[lo:lo + self.max_bucket],
+                          banned_lists[lo:lo + self.max_bucket])
+                     for lo in range(0, b, self.max_bucket)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        bucket = self._bucket_for(b)
+        exe = self._require_exe(bucket)
+        t0 = time.perf_counter()
+        vecs = np.zeros((bucket, self.rank), np.float32)
+        vecs[:b] = user_vecs
+        banned = np.full((bucket, self.banned_width), self.n_items,
+                         np.int32)
+        for row, bl in enumerate(banned_lists):
+            if len(bl):
+                banned[row, :len(bl)] = np.asarray(bl, np.int32)  # lint: ok
+        scores, ixs = jax.device_get(exe(vecs, self.factors, banned))
+        _record_dispatch("sharded", bucket * self.n_items,
+                         time.perf_counter() - t0)
+        return scores[:b], ixs[:b]
+
+
+class ShardedBucketedSimilar(_ShardedPlanBase):
+    """Dense-mask cosine top-k over a row-sharded resident factor
+    matrix (the similar-product template's filter shape): the mask is
+    column-sharded to match the catalog rows, each shard normalizes
+    its own factor slice (row-local math, identical to the
+    single-device program), partial top-k, allgather + merge. Drop-in
+    for `BucketedSimilar`."""
+
+    def __init__(self, item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+                 mesh=None):
+        super().__init__(item_factors, k=k, buckets=buckets, mesh=mesh)
+        self._fn = self._build()
+
+    def _build(self):
+        from jax.sharding import PartitionSpec as P
+        per, kk, k = self.per_shard, self.k_shard, self.k
+
+        def body(query_vecs, factors_local, mask_local):
+            base = jax.lax.axis_index(SHARD_AXIS) * per
+            qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1,
+                                               keepdims=True) + 1e-9)
+            fn = factors_local / (jnp.linalg.norm(factors_local, axis=-1,
+                                                  keepdims=True) + 1e-9)
+            scores = jnp.matmul(qn, fn.T,
+                                precision=jax.lax.Precision.HIGHEST)
+            # padding rows arrive masked False (the caller pads the
+            # mask columns with False), so no gid test is needed here
+            scores = jnp.where(mask_local, scores, NEG_INF)
+            s, ix = jax.lax.top_k(scores, kk)
+            s_all = jax.lax.all_gather(s, SHARD_AXIS)
+            g_all = jax.lax.all_gather(ix + base, SHARD_AXIS)
+            s_cat = jnp.swapaxes(s_all, 0, 1).reshape(s.shape[0], -1)
+            g_cat = jnp.swapaxes(g_all, 0, 1).reshape(s.shape[0], -1)
+            sv, si = jax.lax.top_k(s_cat, k)
+            return sv, jnp.take_along_axis(g_cat, si, axis=1)
+
+        smapped = compat.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(SHARD_AXIS, None), P(None, SHARD_AXIS)),
+            out_specs=(P(), P()))
+        if jax.default_backend() == "cpu":
+            return jax.jit(smapped)
+        return jax.jit(smapped, donate_argnums=(0, 2))
+
+    def warm(self) -> int:
+        """AOT-lower/compile every bucket executable (idempotent)."""
+        compiled = 0
+        for b in self.buckets:
+            if b in self._exe:
+                continue
+            vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
+            mask_spec = jax.ShapeDtypeStruct((b, self.n_pad), np.bool_)
+            self._exe[b] = self._fn.lower(vec_spec, self.factors,
+                                          mask_spec).compile()
+            compiled += 1
+        return compiled
+
+    def fits(self, *, k: int) -> bool:
+        return bool(self._exe) and k <= self.k
+
+    def __call__(self, query_vecs, mask):
+        """Cosine top-k of [b, rank] queries against the sharded
+        catalog under a dense [b, n_items] mask; returns host (scores
+        [b, k], ids [b, k])."""
+        query_vecs = np.asarray(query_vecs, np.float32)  # lint: ok — host in
+        mask = np.asarray(mask, bool)                    # lint: ok — host in
+        b = query_vecs.shape[0]
+        if b > self.max_bucket:
+            parts = [self(query_vecs[lo:lo + self.max_bucket],
+                          mask[lo:lo + self.max_bucket])
+                     for lo in range(0, b, self.max_bucket)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        bucket = self._bucket_for(b)
+        exe = self._require_exe(bucket)
+        t0 = time.perf_counter()
+        vecs = np.zeros((bucket, self.rank), np.float32)
+        vecs[:b] = query_vecs
+        # padding lanes AND padding catalog columns are all-False
+        mask_p = np.zeros((bucket, self.n_pad), bool)
+        mask_p[:b, :self.n_items] = mask
+        scores, ixs = jax.device_get(exe(vecs, self.factors, mask_p))
+        _record_dispatch("sharded", bucket * self.n_items,
+                         time.perf_counter() - t0)
+        return scores[:b], ixs[:b]

@@ -34,7 +34,9 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
-from predictionio_tpu.core import RuntimeContext, extract_params
+from predictionio_tpu.core import (
+    RuntimeContext, WorkflowParams, extract_params,
+)
 from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
@@ -159,6 +161,12 @@ class ServerConfig:
     # how long stop() waits for accepted requests to drain before the
     # socket closes
     drain_timeout_ms: int = 10000
+    # serving mesh spec (e.g. "items=8" or "data=8"); a non-empty value
+    # lands in the server's runtime_conf and FORCES the mesh-sharded
+    # serve path at warm_deploy (ops/topk_sharded.serve_mesh_from_conf).
+    # Empty = auto: shard only when the trained instance recorded a mesh
+    # or the catalog exceeds one device's capacity
+    mesh: str = ""
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -463,7 +471,12 @@ class PredictionServer(HTTPServerBase):
 
         self.config = config
         self._serve_obs = _ServeInstruments(self.metrics)
-        self.ctx = RuntimeContext(registry=registry)
+        # a --mesh deploy flag rides in the server runtime_conf, where
+        # prepare_deploy's serve-mesh derivation (merged with the
+        # instance's trained mesh) picks it up
+        wp = (WorkflowParams(runtime_conf={"mesh": config.mesh})
+              if config.mesh else None)
+        self.ctx = RuntimeContext(registry=registry, workflow_params=wp)
         self.plugin_context = EngineServerPluginContext(plugins)
         self.auth = KeyAuthentication(config.server_key or None)
         self._engine_arg = engine

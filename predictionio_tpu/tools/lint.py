@@ -68,6 +68,7 @@ _RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/")
 # device hot paths: implicit device->host transfers (np.asarray /
 # np.array / float() on a jax array) force a blocking sync per call
 _DEVICE_HOT_PATHS = ("predictionio_tpu/ops/topk.py",
+                     "predictionio_tpu/ops/topk_sharded.py",
                      "predictionio_tpu/serving/")
 
 # template data sources: training reads must use the columnar scan

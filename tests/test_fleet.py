@@ -111,6 +111,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         for t in ("R1", "R2", "R3"):
             raw = _blob(tmp_path, t, "m1").read_bytes()
             assert raw.startswith(integrity.BLOB_MAGIC)
@@ -121,6 +122,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         _corrupt(_blob(tmp_path, "R1", "m1"))
         before = _metric("pio_model_repair_total", target="R1")
         # the read that detects the damage serves from R2 AND heals R1
@@ -136,6 +138,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         _blob(tmp_path, "R1", "m1").unlink()
         assert models.get("m1").models == b"payload"
         assert _blob(tmp_path, "R1", "m1").exists()
@@ -144,6 +147,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         for t in ("R1", "R2", "R3"):
             _corrupt(_blob(tmp_path, t, "m1"))
         with pytest.raises(integrity.CorruptBlobError):
@@ -157,6 +161,7 @@ class TestReplicatedStore:
         models = reg.get_model_data_models()
         faults().arm("storage.R2.Models.insert", error=OSError)
         models.insert(Model("m1", b"payload"))        # 2/3 acks: success
+        models._drain()     # join the failed straggler before asserting
         assert _blob(tmp_path, "R1", "m1").exists()
         assert not _blob(tmp_path, "R2", "m1").exists()
         assert _blob(tmp_path, "R3", "m1").exists()
@@ -185,6 +190,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         _blob(tmp_path, "R1", "m1").unlink()
         faults().arm("storage.R1.Models", error=OSError)   # R1 partitioned
         assert models.get("m1").models == b"payload"
@@ -195,6 +201,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         # silent divergence: R3 holds a VALID envelope of different bytes
         _blob(tmp_path, "R3", "m1").write_bytes(integrity.wrap(b"stale"))
         findings = models.check_divergence(["m1"], repair=True)
@@ -207,6 +214,7 @@ class TestReplicatedStore:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         _corrupt(_blob(tmp_path, "R2", "m1"))
         report = models.fsck(repair=False)
         assert [f["target"] for f in report
@@ -224,6 +232,7 @@ class TestReplicatedStore:
             engine_variant="default", engine_factory="f"))
         models = reg.get_model_data_models()
         models.insert(Model(iid, b"payload"))
+        models._drain()
         _blob(tmp_path, "R2", iid).write_bytes(integrity.wrap(b"stale"))
         report = fsck_mod.doctor(reg, repair=True)
         div = [f for f in report["fsck"]
@@ -232,6 +241,55 @@ class TestReplicatedStore:
         assert div[0]["action"].startswith("rewrote R2")
         assert reg.get_data_object("R2", "Models").get(iid).models \
             == b"payload"
+
+    def test_quorum_ack_does_not_wait_for_slow_straggler(self, tmp_path):
+        """The parallel fan-out: with one target 500 ms slow, the write
+        acks at quorum (2/3 fast targets) well before the straggler —
+        which still converges in the background."""
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        faults().arm("storage.R3.Models.insert", latency=0.5)
+        t0 = time.monotonic()
+        models.insert(Model("m1", b"payload"))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.45, (
+            f"quorum ack waited {elapsed:.3f}s on the slow straggler")
+        assert _blob(tmp_path, "R1", "m1").exists()
+        assert _blob(tmp_path, "R2", "m1").exists()
+        models._drain()                    # straggler converges
+        assert _blob(tmp_path, "R3", "m1").exists()
+        assert _metric("pio_replica_writes_total",
+                       target="R3", outcome="ok") >= 1
+
+    def test_list_model_ids_unions_reachable_targets(self, tmp_path):
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        models.insert(Model("m1", b"payload"))
+        models._drain()
+        # a blob only ONE replica holds (a missed quorum write) is
+        # still enumerable through the union
+        reg.get_data_object("R2", "Models").insert(Model("orphan", b"x"))
+        assert models.list_model_ids() == ["m1", "orphan"]
+        faults().arm("storage.R1.Models", error=OSError)
+        assert models.list_model_ids() == ["m1", "orphan"]
+
+    def test_divergence_sweep_covers_store_enumerated_orphans(
+            self, tmp_path):
+        """A blob with NO engine-instance row (metadata lost / replica
+        missed the delete) still enters the divergence sweep via
+        `list_model_ids` — before satellite 6 the sweep was blind to
+        anything the metadata store forgot."""
+        reg = _replicated_registry(tmp_path)
+        models = reg.get_model_data_models()
+        reg.get_data_object("R1", "Models").insert(Model("ghost", b"pay"))
+        findings = models.check_divergence(models.list_model_ids(),
+                                           repair=True)
+        assert [f["id"] for f in findings] == ["ghost"]
+        assert findings[0]["action"].startswith("rewrote")
+        # doctor wires the same universe end to end
+        report = fsck_mod.doctor(reg, repair=False)
+        assert not [f for f in report["fsck"]
+                    if f["kind"] == "replica_divergence"]
 
     def test_config_validation(self, tmp_path):
         with pytest.raises(StorageError, match=">= 2 target"):
@@ -305,6 +363,7 @@ class TestScheduledFsck:
         reg = _replicated_registry(tmp_path)
         models = reg.get_model_data_models()
         models.insert(Model("m1", b"payload"))
+        models._drain()
         for t in ("R1", "R2"):
             bad = tmp_path / t.lower() / "pio_model_bad"
             bad.write_bytes(integrity.wrap(b"x" * 64)[:-5])
