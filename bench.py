@@ -725,19 +725,19 @@ def _measured_jvm_stand_in(n_users, n_items, rank):
     return p50, p99, qps
 
 
-def _deploy_server(u, i, r, n_users, n_items, batch_window_ms=0):
-    """Train through the real engine workflow on an in-memory registry and
-    deploy the real PredictionServer (the /queries.json hot path of
-    CreateServer.scala:470-591)."""
+def _train_registry(u, i, r, n_users, n_items, storage_config=None):
+    """Train through the real engine workflow and return the (registry,
+    engine) pair holding the completed instance. Defaults to an
+    in-memory registry; `bench_fleet_crosshost` passes a sqlite config
+    so subprocess replicas can load the same trained model."""
     from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
     from predictionio_tpu.data.event import DataMap, Event
     from predictionio_tpu.data.storage import App, StorageRegistry
     from predictionio_tpu.ingest.arrays import RatingColumns
     from predictionio_tpu.ingest.bimap import BiMap
     from predictionio_tpu.models import recommendation as rec
-    from predictionio_tpu.serving import PredictionServer, ServerConfig
 
-    registry = StorageRegistry({
+    registry = StorageRegistry(storage_config or {
         "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
         "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
@@ -766,7 +766,16 @@ def _deploy_server(u, i, r, n_users, n_items, batch_window_ms=0):
         CoreWorkflow.run_train(engine, params, ctx)
     finally:
         rec.RecommendationDataSource._ratings = orig
+    return registry, engine
 
+
+def _deploy_server(u, i, r, n_users, n_items, batch_window_ms=0):
+    """Train through the real engine workflow on an in-memory registry and
+    deploy the real PredictionServer (the /queries.json hot path of
+    CreateServer.scala:470-591)."""
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+
+    registry, engine = _train_registry(u, i, r, n_users, n_items)
     config = ServerConfig(ip="127.0.0.1", port=0,
                           batch_window_ms=batch_window_ms)
     server = PredictionServer(config, registry=registry, engine=engine)
@@ -888,6 +897,194 @@ def bench_fleet(u, i, r, n_users, n_items):
     emit("fleet_reload_qps", len(lat) / window_s, "qps", 1.0)
     # the gate: zero dropped/failed client requests across the roll
     emit("fleet_reload_dropped", float(failed[0]), "requests",
+         1.0 if failed[0] == 0 else 0.0)
+
+
+def _fleet_replica_worker():
+    """Child of bench_fleet_crosshost (argv: --only-fleet-replica-worker
+    <sqlite_path> <router_urls_csv>): load the parent's trained instance
+    from the shared sqlite store, serve it, and self-register with the
+    routers via ReplicaAgent heartbeats. Runs until SIGTERM."""
+    from predictionio_tpu.data.storage import StorageRegistry
+    from predictionio_tpu.models import recommendation as rec
+    from predictionio_tpu.serving import (
+        PredictionServer, ReplicaAgent, ServerConfig,
+    )
+
+    ix = sys.argv.index("--only-fleet-replica-worker")
+    db_path, routers = sys.argv[ix + 1], sys.argv[ix + 2]
+    registry = StorageRegistry({
+        "PIO_STORAGE_SOURCES_PIO_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_PIO_PATH": db_path,
+    })
+    server = PredictionServer(
+        ServerConfig(ip="127.0.0.1", port=0),
+        registry=registry, engine=rec.engine())
+    server.start()
+    agent = ReplicaAgent(server, routers.split(","), heartbeat_s=0.2)
+    agent.start()
+    print(f"# fleet worker serving on {server.port}", file=sys.stderr,
+          flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    while not done.is_set():
+        done.wait(1.0)
+    agent.stop()
+    server.shutdown()
+
+
+def bench_fleet_crosshost(u, i, r, n_users, n_items):
+    """The cross-host fleet gate: 3 SUBPROCESS replicas self-registered
+    over loopback HTTP with a leader router + a standby router sharing a
+    sqlite metadata store (the lease). Open-loop client load runs while
+    the leader is killed without releasing its lease (SIGKILL model) and
+    a rolling reload is then driven through the standby after it takes
+    the lease. A request only counts as failed when NO router serves it
+    within a 10 s failover budget — `fleet_crosshost_dropped` MUST be 0.
+    Handoff time (kill -> standby holds the lease) is reported; the
+    floor is the lease TTL."""
+    import shutil
+    import subprocess
+    import tempfile
+    import urllib.error
+
+    from predictionio_tpu.data.storage import StorageRegistry
+    from predictionio_tpu.serving import (
+        FleetConfig, FleetServer, ServerConfig,
+    )
+
+    if remaining() < 120:
+        print(f"# budget: fleet_crosshost skipped "
+              f"(remaining {remaining():.0f}s)", file=sys.stderr)
+        return
+
+    workdir = tempfile.mkdtemp(prefix="pio_bench_xhost_")
+    db_path = os.path.join(workdir, "pio.db")
+    store_cfg = {"PIO_STORAGE_SOURCES_PIO_TYPE": "SQLITE",
+                 "PIO_STORAGE_SOURCES_PIO_PATH": db_path}
+    _, engine = _train_registry(u, i, r, n_users, n_items,
+                                storage_config=store_cfg)
+
+    lease_ttl = 1.0
+
+    def _router(standby):
+        fleet = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0),
+            FleetConfig(replicas=0, standby=standby, health_interval_s=0.2,
+                        heartbeat_s=0.2, lease_ttl_s=lease_ttl,
+                        drain_timeout_s=2.0),
+            registry=StorageRegistry(store_cfg), engine=engine)
+        fleet.start()
+        return fleet
+
+    leader = _router(standby=False)
+    standby = _router(standby=True)
+    routers = (f"http://127.0.0.1:{leader.port},"
+               f"http://127.0.0.1:{standby.port}")
+    ports = [leader.port, standby.port]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--only-fleet-replica-worker", db_path, routers],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(3)]
+
+    def _admitted():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{leader.port}/status.json",
+                    timeout=5) as resp:
+                st = json.loads(resp.read())
+            return sum(1 for rep in st.get("replicas", [])
+                       if rep.get("admitted"))
+        except (OSError, ValueError):
+            return 0
+
+    lat, failed = [], [0]
+    halt = threading.Event()
+
+    def client(tid):
+        n = 0
+        while not halt.is_set():
+            n += 1
+            payload = {"user": f"u{(tid * 131 + n) % n_users}", "num": 10}
+            t0 = time.perf_counter()
+            ok = False
+            while not ok and time.perf_counter() - t0 < 10.0:
+                for port in ports:
+                    try:
+                        _post(port, payload)
+                        ok = True
+                        break
+                    except urllib.error.HTTPError:
+                        continue   # 307 to leader / 503 mid-handoff
+                    except (OSError, ValueError):
+                        continue   # dead router socket
+                if not ok:
+                    halt.wait(0.02)
+            if ok:
+                lat.append(time.perf_counter() - t0)
+            elif not halt.is_set():
+                failed[0] += 1
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(6)]
+    try:
+        deadline = time.perf_counter() + 90
+        while _admitted() < 3 and time.perf_counter() < deadline:
+            time.sleep(0.1)
+        if _admitted() < 3:
+            raise RuntimeError("replica workers never all registered")
+        for n in range(10):      # warm every worker's serve path
+            _post(leader.port, {"user": f"u{n}", "num": 10})
+        t_load = time.perf_counter()
+        for t in threads:
+            t.start()
+        halt.wait(0.5)           # steady-state traffic before the kill
+        t_kill = time.perf_counter()
+        leader.crash()           # SIGKILL model: the lease is NOT released
+        while (not standby.is_leader()
+               and time.perf_counter() - t_kill < 30):
+            time.sleep(0.01)
+        if not standby.is_leader():
+            raise RuntimeError("standby never took the lease")
+        handoff_s = time.perf_counter() - t_kill
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{standby.port}/reload", data=b"",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=180) as resp:
+            roll = json.loads(resp.read())
+        roll_s = time.perf_counter() - t0
+        halt.wait(0.5)           # post-roll traffic
+        window_s = time.perf_counter() - t_load
+    finally:
+        halt.set()
+        for t in threads:
+            t.join(15)
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        standby.stop()
+        leader.stop()            # idempotent after crash()
+        shutil.rmtree(workdir, ignore_errors=True)
+    reloaded = sum(1 for res in roll["results"]
+                   if res.get("outcome") == "reloaded")
+    if roll["aborted"] or reloaded < 3:
+        raise RuntimeError(f"cross-host roll did not reload every member: "
+                           f"{roll['results']}")
+    p99 = float(np.percentile(lat, 99)) * 1e3 if lat else float("nan")
+    emit("fleet_crosshost_handoff_s", handoff_s, "s", lease_ttl / handoff_s)
+    emit("fleet_crosshost_rolling_reload_s", roll_s, "s", 1.0)
+    emit("fleet_crosshost_p99", p99, "ms", 1.0)
+    emit("fleet_crosshost_qps", len(lat) / window_s, "qps", 1.0)
+    # the gate: zero requests that NO router could serve across replica
+    # registration, leader kill, lease handoff, and the rolling reload
+    emit("fleet_crosshost_dropped", float(failed[0]), "requests",
          1.0 if failed[0] == 0 else 0.0)
 
 
@@ -2148,6 +2345,12 @@ def main():
         signal.signal(signal.SIGTERM, _on_sigterm)
         section(bench_pevlog)
         return
+    if "--only-fleet-replica-worker" in sys.argv:
+        # child of bench_fleet_crosshost: serve the shared-store model
+        # and heartbeat the routers until the parent SIGTERMs us — no
+        # device probe, no metric emission of its own
+        _fleet_replica_worker()
+        return
     if "--only-multichip-worker" in sys.argv:
         # child of bench_multichip_serving: the parent already forced
         # JAX_PLATFORMS=cpu + 8 host devices in our env, so the probe
@@ -2193,6 +2396,7 @@ def main():
         section(bench_seqrec)
         section(bench_serving, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
+        section(bench_fleet_crosshost, u, i, r, n_users, n_items)
         section(bench_ecommerce_scale)
         section(bench_multichip_serving)
         section(bench_serving_large_catalog)

@@ -109,7 +109,22 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--replicas", type=int, default=1,
                    help="serve replicas behind the fleet control plane "
                         "(>1 enables health-gated routing + rolling "
-                        "/reload)")
+                        "/reload; 0 starts a router-only control plane "
+                        "fed by --join replicas)")
+    x.add_argument("--join",
+                   help="comma-separated router URLs: start this server "
+                        "as a standalone fleet replica that registers "
+                        "with (and heartbeats) every listed router, e.g. "
+                        "--join http://router:8000,http://standby:8000")
+    x.add_argument("--advertise",
+                   help="host:port other fleet hosts reach this process "
+                        "at (default 127.0.0.1:<port>; required for "
+                        "real cross-host fleets)")
+    x.add_argument("--standby", action="store_true",
+                   help="start a standby router: no local replicas, "
+                        "learns membership from replica heartbeats, and "
+                        "takes over the leadership lease when the "
+                        "current leader's lease expires")
     x.add_argument("--mesh",
                    help="serving mesh spec (e.g. items=8): forces the "
                         "mesh-sharded serve plan — item factors "
@@ -267,7 +282,8 @@ def main(argv: Optional[list] = None) -> int:
             return 0
         if cmd == "deploy":
             from predictionio_tpu.serving import (
-                FleetConfig, FleetServer, PredictionServer, ServerConfig,
+                FleetServer, PredictionServer, ReplicaAgent, ServerConfig,
+                fleet_config_from_env,
             )
             variant = ops.load_variant(args.engine_json)
             factory = ops.resolve_factory_name(variant, args.engine_factory,
@@ -283,13 +299,37 @@ def main(argv: Optional[list] = None) -> int:
                 batch_window_ms=args.batch_window_ms,
                 mesh=args.mesh or "",
                 server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
-            if args.replicas > 1:
+            if args.join:
+                # standalone replica: serve locally, register with (and
+                # heartbeat) every router listed
+                server = PredictionServer(config, registry=registry)
+                port = server.start()
+                fc = fleet_config_from_env(registry.config)
+                agent = ReplicaAgent(
+                    server, args.join.split(","),
+                    advertise=args.advertise or "",
+                    server_key=config.server_key,
+                    heartbeat_s=fc.heartbeat_s)
+                agent.start()
+                print(f"Fleet replica started on {args.ip}:{port}, "
+                      f"joined {args.join}", flush=True)
+                try:
+                    _serve_forever(server)
+                finally:
+                    agent.stop()
+                return 0
+            if args.replicas > 1 or args.replicas == 0 or args.standby:
+                replicas = 0 if args.standby else args.replicas
                 server = FleetServer(
-                    config, FleetConfig(replicas=args.replicas),
+                    config, fleet_config_from_env(
+                        registry.config, replicas=replicas,
+                        standby=args.standby,
+                        advertise=args.advertise or ""),
                     registry=registry)
                 port = server.start()
-                print(f"Fleet control plane started on {args.ip}:{port} "
-                      f"({args.replicas} replicas)", flush=True)
+                role = "standby router" if args.standby else "control plane"
+                print(f"Fleet {role} started on {args.ip}:{port} "
+                      f"({replicas} local replicas)", flush=True)
             else:
                 server = PredictionServer(config, registry=registry)
                 port = server.start()

@@ -7,8 +7,8 @@ reference's `Storage` object.
 from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
     EngineInstanceStatus, EngineInstances, EvaluationInstance,
-    EvaluationInstanceStatus, EvaluationInstances, EventStore, Model, Models,
-    StorageError, StorageWriteError,
+    EvaluationInstanceStatus, EvaluationInstances, EventStore, Lease, Leases,
+    Model, Models, StorageError, StorageWriteError,
 )
 from predictionio_tpu.data.storage.registry import (
     StorageRegistry, register_driver, set_default, storage,
@@ -18,6 +18,7 @@ __all__ = [
     "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
     "EngineInstance", "EngineInstanceStatus", "EngineInstances",
     "EvaluationInstance", "EvaluationInstanceStatus", "EvaluationInstances",
-    "EventStore", "Model", "Models", "StorageError", "StorageWriteError",
+    "EventStore", "Lease", "Leases", "Model", "Models", "StorageError",
+    "StorageWriteError",
     "StorageRegistry", "register_driver", "set_default", "storage",
 ]

@@ -163,6 +163,25 @@ class Model:
     models: bytes
 
 
+@dataclass(frozen=True)
+class Lease:
+    """A TTL lease row: the fleet control plane's leader-election
+    primitive (no reference analog — the reference's CreateServer is a
+    single actor system; cross-host leader handoff needs shared state).
+
+    `journal` is an opaque payload the holder may update while the
+    lease is held — the fleet writes its rolling-reload progress there
+    so a standby taking over can detect a half-rolled fleet and finish
+    or abort it instead of leaving it silently mixed."""
+    name: str
+    holder: str
+    expires_at: datetime
+    journal: str = ""
+
+    def expired(self, now: Optional[datetime] = None) -> bool:
+        return (now or utcnow()) >= self.expires_at
+
+
 # ---------------------------------------------------------------------------
 # DAO interfaces
 # ---------------------------------------------------------------------------
@@ -318,6 +337,37 @@ class Models(abc.ABC):
         ESCAPED names; instance ids are alphanumeric so the escape is
         the identity for every id the system itself writes."""
         return []
+
+
+class Leases(abc.ABC):
+    """TTL lease DAO — compare-and-swap leader election on shared
+    storage. Acquire semantics (the only subtle part): `acquire`
+    succeeds iff the row is absent, expired, or already held by the
+    same holder (re-acquire == renew). Clocks are the metadata store's
+    callers' — holders must pick TTLs that dominate their renewal
+    jitter, not rely on sub-second fencing."""
+
+    @abc.abstractmethod
+    def acquire(self, name: str, holder: str, ttl_s: float,
+                journal: Optional[str] = None) -> Optional[Lease]:
+        """CAS-acquire/renew `name` for `holder` with a fresh TTL.
+        Returns the new lease row on success, None when a different
+        holder's unexpired lease exists. `journal=None` preserves the
+        row's existing journal — even across a holder change, so a
+        standby taking over an expired lease inherits the previous
+        leader's roll journal atomically; a string replaces it (empty
+        string clears it)."""
+
+    @abc.abstractmethod
+    def get(self, name: str) -> Optional[Lease]:
+        """The current row, expired or not; None when absent. Callers
+        decide what expiry means (`lease.expired()`)."""
+
+    @abc.abstractmethod
+    def release(self, name: str, holder: str) -> bool:
+        """Delete the row iff `holder` still owns it. True when the
+        row was deleted (a graceful step-down); False when someone
+        else holds it or it is gone already."""
 
 
 # ---------------------------------------------------------------------------

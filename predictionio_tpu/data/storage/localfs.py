@@ -14,14 +14,17 @@ files from interrupted writes.
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
+import threading
 from pathlib import Path
 from typing import List, Optional
 
 from predictionio_tpu.data import integrity
-from predictionio_tpu.data.event import utcnow
+from predictionio_tpu.data.event import from_millis, to_millis, utcnow
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.data.storage.base import Lease, Model
 from predictionio_tpu.resilience import FaultError, faults
 
 
@@ -32,6 +35,90 @@ class LocalFSStorageClient:
         self.path = Path(os.path.expanduser(path))
         self.path.mkdir(parents=True, exist_ok=True)
         self.source_name = self.config.get("SOURCE_NAME", "LOCALFS")
+
+
+class LocalFSLeases(base.Leases):
+    """Lease row as a JSON file (`pio_lease_<name>`), CAS'd under an
+    O_EXCL lockfile — the only cross-process mutual exclusion a plain
+    filesystem offers. A lockfile left behind by a crashed holder is
+    broken after `_STALE_LOCK_S` (the CAS critical section is a few
+    syscalls; anything holding it for seconds is dead)."""
+
+    _STALE_LOCK_S = 5.0
+
+    def __init__(self, client: LocalFSStorageClient):
+        self.c = client
+
+    def _file(self, name: str) -> Path:
+        safe = "".join(ch if ch.isalnum() or ch in "-_" else "_"
+                       for ch in name)
+        return self.c.path / f"pio_lease_{safe}"
+
+    @contextlib.contextmanager
+    def _cas_lock(self, name: str, timeout_s: float = 2.0):
+        lock = self._file(name).with_name(self._file(name).name + ".lock")
+        pause = threading.Event()
+        waited = 0.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                try:
+                    age = utcnow().timestamp() - lock.stat().st_mtime
+                    if age > self._STALE_LOCK_S:
+                        lock.unlink(missing_ok=True)
+                        continue
+                except OSError:
+                    continue    # lock vanished between open and stat
+                if waited >= timeout_s:
+                    raise base.StorageUnavailableError(
+                        f"lease lockfile {lock} held for {waited:.1f}s")
+                pause.wait(0.005)
+                waited += 0.005
+        try:
+            yield
+        finally:
+            lock.unlink(missing_ok=True)
+
+    def _read(self, name: str) -> Optional[Lease]:
+        try:
+            data = json.loads(self._file(name).read_bytes())
+        except (OSError, ValueError):
+            return None
+        return Lease(data["name"], data["holder"],
+                     from_millis(data["expires_ms"]),
+                     data.get("journal", ""))
+
+    def acquire(self, name: str, holder: str, ttl_s: float,
+                journal: Optional[str] = None) -> Optional[Lease]:
+        with self._cas_lock(name):
+            cur = self._read(name)
+            now = utcnow()
+            if cur is not None and cur.holder != holder \
+                    and not cur.expired(now):
+                return None
+            keep = (cur.journal if cur is not None else "") \
+                if journal is None else journal
+            exp_ms = to_millis(now) + int(ttl_s * 1000)
+            lease = Lease(name, holder, from_millis(exp_ms), keep)
+            integrity.atomic_write_bytes(self._file(name), json.dumps({
+                "name": name, "holder": holder, "expires_ms": exp_ms,
+                "journal": keep}).encode())
+            return lease
+
+    def get(self, name: str) -> Optional[Lease]:
+        # atomic rename on write: an unlocked read never sees a torn row
+        return self._read(name)
+
+    def release(self, name: str, holder: str) -> bool:
+        with self._cas_lock(name):
+            cur = self._read(name)
+            if cur is None or cur.holder != holder:
+                return False
+            self._file(name).unlink(missing_ok=True)
+            return True
 
 
 class LocalFSModels(base.Models):

@@ -10,14 +10,14 @@ from __future__ import annotations
 import itertools
 import threading
 import uuid
-from datetime import datetime
+from datetime import datetime, timedelta
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
-from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
-    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model, _UNSET,
-    match_event,
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Lease, Model,
+    _UNSET, match_event,
 )
 
 
@@ -33,6 +33,7 @@ class MemStorageClient:
         self.engine_instances: Dict[str, EngineInstance] = {}
         self.evaluation_instances: Dict[str, EvaluationInstance] = {}
         self.models: Dict[str, Model] = {}
+        self.leases: Dict[str, Lease] = {}
         # (app_id, channel_id) -> event_id -> Event
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
         self._app_seq = itertools.count(1)
@@ -222,6 +223,39 @@ class MemModels(base.Models):
     def list_model_ids(self) -> List[str]:
         with self.c.lock:
             return sorted(self.c.models)
+
+
+class MemLeases(base.Leases):
+    def __init__(self, client: MemStorageClient):
+        self.c = client
+
+    def acquire(self, name: str, holder: str, ttl_s: float,
+                journal: Optional[str] = None) -> Optional[Lease]:
+        with self.c.lock:
+            now = utcnow()
+            cur = self.c.leases.get(name)
+            if cur is not None and cur.holder != holder \
+                    and not cur.expired(now):
+                return None
+            # journal=None inherits the row's journal even across a
+            # holder change — a standby taking over an expired lease
+            # must not wipe the previous leader's roll journal
+            keep = (cur.journal if cur is not None else "") \
+                if journal is None else journal
+            lease = Lease(name, holder, now + timedelta(seconds=ttl_s), keep)
+            self.c.leases[name] = lease
+            return lease
+
+    def get(self, name: str) -> Optional[Lease]:
+        return self.c.leases.get(name)
+
+    def release(self, name: str, holder: str) -> bool:
+        with self.c.lock:
+            cur = self.c.leases.get(name)
+            if cur is None or cur.holder != holder:
+                return False
+            del self.c.leases[name]
+            return True
 
 
 class MemEvents(base.EventStore):

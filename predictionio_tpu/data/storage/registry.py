@@ -51,6 +51,7 @@ def _register_builtin_drivers() -> None:
         "EngineInstances": memory.MemEngineInstances,
         "EvaluationInstances": memory.MemEvaluationInstances,
         "Models": memory.MemModels,
+        "Leases": memory.MemLeases,
         "Events": memory.MemEvents,
     })
     register_driver("SQLITE", sqlite.SQLiteStorageClient, {
@@ -60,10 +61,12 @@ def _register_builtin_drivers() -> None:
         "EngineInstances": sqlite.SQLiteEngineInstances,
         "EvaluationInstances": sqlite.SQLiteEvaluationInstances,
         "Models": sqlite.SQLiteModels,
+        "Leases": sqlite.SQLiteLeases,
         "Events": sqlite.SQLiteEvents,
     })
     register_driver("LOCALFS", localfs.LocalFSStorageClient, {
         "Models": localfs.LocalFSModels,
+        "Leases": localfs.LocalFSLeases,
     })
     from predictionio_tpu.data.storage import evlog, objectstore, postgres
 
@@ -108,7 +111,8 @@ def _register_builtin_drivers() -> None:
     # (quorum writes + read-repair; see replicated.py)
     from predictionio_tpu.data.storage import replicated
     register_driver("REPLICATED", replicated.ReplicatedStorageClient,
-                    {"Models": replicated.ReplicatedModels})
+                    {"Models": replicated.ReplicatedModels,
+                     "Leases": replicated.ReplicatedLeases})
 
 
 _register_builtin_drivers()
@@ -322,6 +326,13 @@ class StorageRegistry:
 
     def get_model_data_models(self) -> base.Models:
         return self._repo_dao("MODELDATA", "Models")
+
+    def get_leases(self) -> base.Leases:
+        """Lease DAO on the MODELDATA repo's source (the store every
+        router in a fleet shares). Sources whose driver has no Leases
+        DAO (object stores) raise StorageError — the fleet degrades to
+        always-leader with a warning."""
+        return self._repo_dao("MODELDATA", "Leases")
 
     def get_events(self) -> base.EventStore:
         """The LEvents/PEvents analog (training reads go through ingest/)."""

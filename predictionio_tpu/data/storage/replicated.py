@@ -53,7 +53,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 # in sys.modules (usable at call time), its names are not yet
 from predictionio_tpu.data import integrity
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.base import Model, StorageError
+from predictionio_tpu.data.storage.base import Lease, Model, StorageError
 from predictionio_tpu.obs import get_logger, get_registry
 
 _log = get_logger("storage.replicated")
@@ -416,3 +416,86 @@ class ReplicatedModels(base.Models):
                 f.setdefault("target", name)
             findings.extend(found)
         return findings
+
+
+class ReplicatedLeases(base.Leases):
+    """Quorum lease over the targets: a holder is the leader only while
+    a majority of target stores agree. Two routers racing through a
+    partition can each win a minority of targets, but never two
+    overlapping majorities — the same overlap argument as the quorum
+    writes above. A failed (sub-quorum) acquire releases the targets it
+    did win, so a partial grab never starves the actual winner."""
+
+    def __init__(self, client: ReplicatedStorageClient):
+        self.c = client
+        self._lock = threading.Lock()
+        self._daos: Optional[List[Tuple[str, base.Leases]]] = None
+
+    def _targets(self) -> List[Tuple[str, base.Leases]]:
+        with self._lock:
+            if self._daos is None:
+                daos = []
+                for t in self.c.targets:
+                    try:
+                        daos.append(
+                            (t, self.c.registry.get_data_object(t, "Leases")))
+                    except StorageError as e:
+                        _log.warning("lease_target_unsupported", target=t,
+                                     error=str(e))
+                self._daos = daos
+            return self._daos
+
+    def acquire(self, name: str, holder: str, ttl_s: float,
+                journal: Optional[str] = None) -> Optional[Lease]:
+        targets = self._targets()
+        won: List[Tuple[str, base.Leases]] = []
+        lease: Optional[Lease] = None
+        for tname, dao in targets:
+            try:
+                got = dao.acquire(name, holder, ttl_s, journal)
+            except (StorageError, OSError) as e:
+                _log.warning("lease_acquire_failed", target=tname,
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            if got is not None:
+                won.append((tname, dao))
+                lease = got
+        if len(won) >= self.c.quorum:
+            return lease
+        for tname, dao in won:
+            try:
+                dao.release(name, holder)
+            except (StorageError, OSError):
+                pass    # its TTL will expire it
+        return None
+
+    def get(self, name: str) -> Optional[Lease]:
+        """The majority holder's freshest row; with no majority, the
+        freshest row seen (conservative: callers treat any row as a
+        possibly-live lease)."""
+        rows: List[Lease] = []
+        for tname, dao in self._targets():
+            try:
+                row = dao.get(name)
+            except (StorageError, OSError):
+                continue
+            if row is not None:
+                rows.append(row)
+        if not rows:
+            return None
+        by_holder: Dict[str, List[Lease]] = {}
+        for row in rows:
+            by_holder.setdefault(row.holder, []).append(row)
+        majority = [ls for ls in by_holder.values()
+                    if len(ls) >= self.c.quorum]
+        pool = majority[0] if majority else rows
+        return max(pool, key=lambda l: l.expires_at)
+
+    def release(self, name: str, holder: str) -> bool:
+        released = False
+        for tname, dao in self._targets():
+            try:
+                released = dao.release(name, holder) or released
+            except (StorageError, OSError):
+                pass
+        return released

@@ -63,6 +63,9 @@ META_DDL = (
     """CREATE TABLE IF NOT EXISTS models_quarantine (
         id TEXT PRIMARY KEY, models BLOB, reason TEXT,
         quarantined_at INTEGER)""",
+    """CREATE TABLE IF NOT EXISTS leases (
+        name TEXT PRIMARY KEY, holder TEXT NOT NULL,
+        expires_ms INTEGER NOT NULL, journal TEXT NOT NULL)""",
 )
 
 # Additive schema migrations for stores created before a column existed;
@@ -484,6 +487,54 @@ class SQLiteModels(base.Models):
                           f"{retention_s:.0f}s retention",
                 "action": "deleted"})
         return findings
+
+
+class SQLiteLeases(base.Leases):
+    """CAS lease over a single row; the connection lock + transaction
+    make the read-check-write atomic within this process, and WAL's
+    writer exclusivity makes it atomic across processes sharing the
+    db file (the cross-host deployment runs all routers against one
+    shared metadata store)."""
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    @staticmethod
+    def _from_row(r) -> base.Lease:
+        return base.Lease(r[0], r[1], from_millis(r[2]), r[3] or "")
+
+    def acquire(self, name: str, holder: str, ttl_s: float,
+                journal: Optional[str] = None) -> Optional[base.Lease]:
+        now = utcnow()
+        now_ms = to_millis(now)
+        exp_ms = now_ms + int(ttl_s * 1000)
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute("BEGIN IMMEDIATE")
+            row = self.c.conn.execute(
+                "SELECT name, holder, expires_ms, journal FROM leases "
+                "WHERE name=?", (name,)).fetchone()
+            if row is not None and row[1] != holder and row[2] > now_ms:
+                return None
+            keep = (row[3] if row is not None else "") \
+                if journal is None else journal
+            self.c.conn.execute(
+                "INSERT OR REPLACE INTO leases (name, holder, expires_ms, "
+                "journal) VALUES (?,?,?,?)", (name, holder, exp_ms, keep))
+        return base.Lease(name, holder, from_millis(exp_ms), keep or "")
+
+    def get(self, name: str) -> Optional[base.Lease]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT name, holder, expires_ms, journal FROM leases "
+                "WHERE name=?", (name,)).fetchone()
+        return self._from_row(row) if row else None
+
+    def release(self, name: str, holder: str) -> bool:
+        with self.c.lock, self.c.conn:
+            cur = self.c.conn.execute(
+                "DELETE FROM leases WHERE name=? AND holder=?",
+                (name, holder))
+            return cur.rowcount > 0
 
 
 class SQLiteEvents(base.EventStore):

@@ -117,6 +117,30 @@ class FaultInjector:
                     err = err(f"injected fault at {seam}")
                 raise err
 
+    def dropped(self, seam: str) -> bool:
+        """Packet-loss seam entry point: True when a matching rule is
+        armed — the caller then behaves as if the message NEVER ARRIVED
+        (a partition) instead of raising an error back to the sender.
+        Counts as an injection; latency rules still apply. Seams:
+        `fleet.net.<member>.heartbeat` (membership path) and
+        `fleet.net.<member>.data` (query proxy path) let chaos tests
+        distinguish a partitioned member from a crashed one."""
+        if not self._rules:      # fast path: harness disarmed
+            return False
+        fired: List[FaultRule] = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.torn is not None:
+                    continue
+                if rule.matches(seam) and not rule.exhausted():
+                    rule.hits += 1
+                    fired.append(rule)
+        for rule in fired:
+            self._count(seam)
+            if rule.latency > 0:
+                time.sleep(rule.latency)
+        return bool(fired)
+
     def torn_fraction(self, seam: str) -> Optional[float]:
         """Torn-write seam entry point: returns the fraction of bytes the
         caller should persist before simulating a crash, or None when no

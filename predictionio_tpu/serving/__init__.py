@@ -17,7 +17,7 @@ from predictionio_tpu.serving.server import (  # noqa: F401
     PredictionServer, ServerConfig,
 )
 from predictionio_tpu.serving.fleet import (  # noqa: F401
-    FleetConfig, FleetServer,
+    FleetConfig, FleetServer, ReplicaAgent, fleet_config_from_env,
 )
 from predictionio_tpu.serving.plugins import (  # noqa: F401
     EngineServerPlugin, EngineServerPluginContext, OUTPUT_BLOCKER,

@@ -1,30 +1,57 @@
-"""Fleet control plane: replica-set serving + zero-downtime rolling
-reloads.
+"""Fleet control plane: replica-set serving, cross-host membership,
+lease-based leader handoff, zero-downtime rolling reloads.
 
 `pio-tpu deploy --replicas N` puts N in-process `PredictionServer`
 workers (each with its own micro-batcher, deployment, and loopback
-port) behind this router. The control plane:
+port) behind this router. `pio-tpu deploy --join http://router:8000`
+starts a STANDALONE replica anywhere on the network that registers
+itself with the router(s) and heartbeats; in-process workers and
+remote members live in the same membership table and are routed,
+health-gated, and rolled identically. The control plane:
 
-  - health-gates routing: a replica serves traffic only while admitted;
-    the monitor thread probes each replica's `/ready` every
-    `health_interval_s` and ejects after `eject_threshold` consecutive
-    failures (probe failures and routing-observed connection errors /
-    5xx responses feed the same counter), re-admitting on the first
-    healthy probe after recovery
-  - routes `/queries.json` round-robin over admitted replicas and
-    RETRIES connection-level failures on the next healthy replica, so
-    a replica dying mid-request costs the client nothing; HTTP error
-    responses (the replica answered — a 503 shed, a 400 bad query)
-    pass through untouched
-  - implements rolling `/reload`: one replica at a time is ejected
-    from routing, drained (its in-flight proxied requests finish),
-    reloaded (the replica's own PR-2 last-good rollback + PR-4
-    warm_deploy apply inside its /reload), probed, and re-admitted
-    before the next begins. A replica that DIES mid-reload is left
-    ejected and the roll continues (N-1 replicas still serve); a
-    replica whose load FAILS (HTTP 500, rolled back to last-good) is
-    re-admitted on the old model and the roll ABORTS — the new model
-    is bad and would fail on every other replica too.
+  - health-gates routing on heartbeat age + probe suspicion: a member
+    serves traffic only while admitted. Remote members heartbeat
+    `POST /fleet/heartbeat` (model id + readiness); the monitor thread
+    probes `/ready` every `health_interval_s`. Ejection needs BOTH
+    `eject_threshold` consecutive suspicions AND a stale heartbeat
+    (probes alone can lie during a partition) — except data-path
+    evidence (connection errors / 5xx seen while routing), which
+    ejects on the threshold alone. First healthy probe or ready
+    heartbeat re-admits.
+  - routes `/queries.json` round-robin over admitted members and
+    RETRIES connection-level failures on the next healthy member, so
+    a member dying mid-request costs the client nothing; HTTP error
+    responses (the member answered — a 503 shed, a 400 bad query)
+    pass through untouched. A request whose deadline budget is
+    already spent is shed with 504 BEFORE dialing
+    (`pio_shed_total{surface="deadline"}`).
+  - elects a LEADER through a TTL lease in the metadata store
+    (`data.storage.base.Leases`): every router — including standbys
+    started with `--standby` — runs the same acquire/renew loop, and
+    the CAS in the store guarantees at most one holder. Non-leaders
+    307-redirect `/queries.json` to the leader and refuse `/reload`,
+    so at most one router ever rolls the fleet (split-brain safe even
+    when routers can't see each other). When the leader dies, its
+    lease expires and a standby takes over within ~`lease_ttl_s`,
+    rebuilding membership from heartbeats (remote agents beat ALL
+    routers) and the persisted member snapshot.
+  - implements rolling `/reload` (leader-only): one member at a time
+    is ejected from routing, drained, reloaded (the replica's own
+    last-good rollback + warm_deploy apply inside its /reload),
+    probed, and re-admitted before the next begins. Progress is
+    journaled through the lease row, so a leader that dies mid-roll
+    hands the remaining members to the next leader, which resumes the
+    roll — a roll always completes or rolls back, never stalls
+    half-applied. A member that DIES mid-reload is left ejected and
+    the roll continues; a member whose load FAILS (HTTP 500, rolled
+    back to last-good) is re-admitted on the old model and the roll
+    ABORTS; a member that is partitioned away (ejected and
+    unreachable) is SKIPPED — ejected from routing, not rolled.
+
+Partition chaos seams (`resilience.faults`): `fleet.net.<member>.heartbeat`
+drops probes and heartbeats for a member, `fleet.net.<member>.data`
+drops its proxied query traffic; arming one or both simulates the
+partition classes the membership logic must survive.
 
 One fsck/janitor sweep runs per fleet (the control plane's; replicas
 are built with `startup_check=False`), as does the single scheduled
@@ -41,10 +68,13 @@ import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence
 
+from predictionio_tpu.data.storage.base import Model, StorageError
 from predictionio_tpu.obs import MetricsRegistry, get_logger
-from predictionio_tpu.resilience import current_deadline
+from predictionio_tpu.resilience import (
+    DeadlineExceeded, current_deadline, faults,
+)
 from predictionio_tpu.serving.server import PredictionServer, ServerConfig
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
@@ -57,6 +87,11 @@ _log = get_logger("serving.fleet")
 _FORWARD_HEADERS = ("X-PIO-Deadline-Ms", "X-Request-ID", "Authorization",
                     "Content-Type")
 
+# reserved model-store id for the membership snapshot (per variant);
+# fsck's divergence sweep reports but never deletes unknown ids, so the
+# blob is safe alongside real model envelopes
+_MEMBERS_BLOB_PREFIX = "__fleet_members__"
+
 
 @dataclass
 class FleetConfig:
@@ -65,36 +100,108 @@ class FleetConfig:
     replicas: int = 3
     # /ready probe cadence for the health monitor
     health_interval_s: float = 1.0
-    # consecutive failures (probe, connection, 5xx) before ejection
+    # consecutive suspicions (probe, connection, 5xx) before ejection
+    # (env: PIO_FLEET_SUSPECT_N)
     eject_threshold: int = 3
     # per-attempt proxy timeout when the request carries no deadline
     proxy_timeout_s: float = 30.0
     # rolling reload: max wait for a replica's in-flight requests
     drain_timeout_s: float = 10.0
+    # expected remote-heartbeat cadence; 0 = derive from
+    # health_interval_s (env: PIO_FLEET_HEARTBEAT_S)
+    heartbeat_s: float = 0.0
+    # leadership lease TTL; a dead leader's lease expires after this
+    # and a standby takes over (env: PIO_FLEET_LEASE_TTL_S)
+    lease_ttl_s: float = 10.0
+    # standby router: no local replicas, contends for the lease
+    standby: bool = False
+    # address other hosts reach this router at ("host:port");
+    # default 127.0.0.1:<bound port> (single-host fleets)
+    advertise: str = ""
+    # per-member /reload call budget during a roll
+    reload_timeout_s: float = 120.0
+
+    def effective_heartbeat_s(self) -> float:
+        return self.heartbeat_s if self.heartbeat_s > 0 \
+            else self.health_interval_s
+
+
+def fleet_config_from_env(cfg: Mapping[str, str], **overrides) -> FleetConfig:
+    """FleetConfig from environment-style config (the CLI path). Env
+    knobs: PIO_FLEET_LEASE_TTL_S, PIO_FLEET_HEARTBEAT_S,
+    PIO_FLEET_SUSPECT_N; explicit `overrides` win."""
+    kw: Dict[str, object] = {}
+    try:
+        if cfg.get("PIO_FLEET_LEASE_TTL_S"):
+            kw["lease_ttl_s"] = float(cfg["PIO_FLEET_LEASE_TTL_S"])  # lint: ok — host str
+        if cfg.get("PIO_FLEET_HEARTBEAT_S"):
+            kw["heartbeat_s"] = float(cfg["PIO_FLEET_HEARTBEAT_S"])  # lint: ok — host str
+        if cfg.get("PIO_FLEET_SUSPECT_N"):
+            kw["eject_threshold"] = int(cfg["PIO_FLEET_SUSPECT_N"])  # lint: ok — host str
+    except ValueError as e:
+        raise ValueError(f"bad PIO_FLEET_* value: {e}") from e
+    kw.update(overrides)
+    return FleetConfig(**kw)
 
 
 class _Replica:
-    """One managed PredictionServer worker and its routing state."""
+    """One fleet member and its routing state — either a managed
+    in-process PredictionServer worker (`server` set, loopback port) or
+    a REMOTE replica that registered over HTTP (`server` is None; all
+    the control plane knows is its address and its heartbeats)."""
 
-    def __init__(self, index: int, server: PredictionServer):
+    def __init__(self, index: int, server: Optional[PredictionServer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
         self.index = index
         self.server = server
-        self.port = 0
+        self.host = host
+        self.port = port
         self.lock = threading.Lock()
         self.admitted = False
         self.state = "starting"   # serving|ejected|reloading|dead
-        self.failures = 0         # consecutive probe/route failures
+        self.failures = 0         # consecutive probe/route suspicions
         self.inflight = 0
+        self.last_beat = time.monotonic()
+        self.ejected_at = 0.0     # monotonic stamp of last eject evidence
+        self.model_id = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def remote(self) -> bool:
+        return self.server is None
+
+    def beat(self, model_id: Optional[str] = None) -> None:
+        with self.lock:
+            self.last_beat = time.monotonic()
+            if model_id is not None:
+                self.model_id = model_id
+
+    def beat_age(self) -> float:
+        return time.monotonic() - self.last_beat
+
+    def running(self) -> bool:
+        """In-process: the server object knows. Remote: only probes and
+        heartbeats do — a remote member is running unless marked dead."""
+        if self.server is not None:
+            return self.server.is_running()
+        return self.state != "dead"
 
     def snapshot(self) -> dict:
         with self.lock:
             return {"replica": self.index, "port": self.port,
+                    "member": f"{self.host}:{self.port}",
+                    "remote": self.server is None,
                     "state": self.state, "admitted": self.admitted,
-                    "failures": self.failures, "inflight": self.inflight}
+                    "failures": self.failures, "inflight": self.inflight,
+                    "model": self.model_id,
+                    "beat_age_s": round(time.monotonic() - self.last_beat, 3)}
 
 
 class FleetServer(HTTPServerBase):
-    """The tiny control plane in front of N PredictionServer replicas."""
+    """The tiny control plane in front of N PredictionServer members."""
 
     def __init__(self, config: ServerConfig,
                  fleet: Optional[FleetConfig] = None, registry=None,
@@ -108,8 +215,10 @@ class FleetServer(HTTPServerBase):
 
         self.config = config
         self.fleet = fleet if fleet is not None else FleetConfig()
-        if self.fleet.replicas < 1:
-            raise ValueError("a fleet needs at least 1 replica")
+        if self.fleet.replicas < 0:
+            raise ValueError(
+                "replicas must be >= 0 (0 = router-only: --join feeds "
+                "members, or --standby contends for the lease)")
         self.ctx = RuntimeContext(registry=registry)
         self.auth = KeyAuthentication(config.server_key or None)
         self._engine_arg = engine
@@ -121,6 +230,19 @@ class FleetServer(HTTPServerBase):
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._fleet_obs = _fleet_metrics(self.metrics)
+        # leadership: holder identity is the advertised address; the
+        # lease DAO lives in the store every router shares. Until the
+        # first lease tick this router is NOT leader (no routing).
+        self._members_lock = threading.Lock()
+        self._advertise = self.fleet.advertise
+        self._holder = self._advertise
+        self._leases = None
+        self._lease_name = (
+            f"fleet-leader-{config.engine_variant or 'default'}")
+        self._is_leader = False
+        self._leader_hint = ""
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
         # ONE recovery sweep + ONE scheduled-fsck thread per fleet
         from predictionio_tpu.data.fsck import (
             start_scheduled_fsck, startup_check,
@@ -150,47 +272,301 @@ class FleetServer(HTTPServerBase):
             rep.port = server.start(background=True)
             self._replicas.append(rep)
             if self._probe(rep):
+                rep.beat()
                 self._admit(rep)
             _log.info("replica_started", replica=i, port=rep.port,
                       admitted=rep.admitted)
+        # bind first so the advertised address (and lease holder id)
+        # carries the real port even when config.port == 0
+        port = super().start(background=True)
+        if not self._advertise:
+            self._advertise = f"127.0.0.1:{port}"
+        self._holder = self._advertise
+        self._resolve_leases()
+        self._restore_members()
+        # leadership settles before start() returns: a fresh single
+        # router is leader immediately; a standby next to a live leader
+        # observes the holder and stays passive
+        self._lease_tick()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="pio-fleet-health", daemon=True)
         self._monitor.start()
-        return super().start(background)
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="pio-fleet-lease", daemon=True)
+        self._lease_thread.start()
+        if not background and self._thread is not None:
+            self._thread.join()
+        return port
 
     def stop(self) -> None:
         """Stop the fleet: replicas drain gracefully (their stop()
-        finishes accepted work), then the router socket closes."""
+        finishes accepted work), the lease is RELEASED (a standby can
+        take over immediately instead of waiting out the TTL), then
+        the router socket closes."""
         with self._rr_lock:
             if self._stopping:
                 return
             self._stopping = True
         self._monitor_stop.set()
-        for rep in self._replicas:
+        self._lease_stop.set()
+        for rep in list(self._replicas):
             with rep.lock:
                 rep.admitted = False
                 rep.state = "stopping"
+            if rep.server is None:
+                continue
             try:
                 rep.server.stop()
             except Exception as e:
                 _log.warning("replica_stop_failed", replica=rep.index,
                              error=f"{type(e).__name__}: {e}")
+        if self._leases is not None and self._is_leader:
+            try:
+                self._leases.release(self._lease_name, self._holder)
+            except Exception as e:
+                _log.warning("lease_release_failed",
+                             error=f"{type(e).__name__}: {e}")
+        self._is_leader = False
+        self._fleet_obs["leader"].set(0.0)
+        if self._fsck_sched is not None:
+            self._fsck_sched.stop()
+        self.shutdown()
+
+    def crash(self) -> None:
+        """Chaos hook (tests/bench): die the way a SIGKILLed router
+        does — no drain, no snapshot, and crucially NO lease release,
+        so failover exercises the TTL-expiry path. In-process replicas
+        are left running (use router-only fleets to model a real
+        cross-host leader crash)."""
+        with self._rr_lock:
+            self._stopping = True
+        self._monitor_stop.set()
+        self._lease_stop.set()
         if self._fsck_sched is not None:
             self._fsck_sched.stop()
         self.shutdown()
 
     def readiness(self):
-        """/ready: the fleet serves while >=1 replica is admitted."""
+        """/ready: the fleet serves while >=1 member is admitted."""
         admitted = [r.index for r in self._replicas
-                    if r.admitted and r.server.is_running()]
+                    if r.admitted and r.running()]
         return (bool(admitted),
-                {"replicas": len(self._replicas), "admitted": admitted})
+                {"replicas": len(self._replicas), "admitted": admitted,
+                 "leader": self._is_leader})
+
+    # -- leadership ---------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def _resolve_leases(self) -> None:
+        try:
+            self._leases = self.ctx.registry.get_leases()
+        except StorageError as e:
+            # store without a lease DAO: degrade to always-leader (the
+            # pre-lease behavior — fine for a single router, unsafe
+            # only if the operator runs two routers anyway)
+            self._leases = None
+            _log.warning("lease_dao_unavailable_always_leader", error=str(e))
+
+    def _lease_tick(self) -> None:
+        if self._leases is None:
+            if not self._is_leader:
+                self._become_leader(previous="", journal="")
+            return
+        try:
+            cur = self._leases.get(self._lease_name)
+            got = self._leases.acquire(
+                self._lease_name, self._holder, self.fleet.lease_ttl_s)
+        except Exception as e:
+            # storage flake: keep the current role; if we are leader
+            # and stay cut off, the TTL expires us from everyone
+            # else's point of view, which is the safe outcome
+            _log.warning("lease_tick_failed",
+                         error=f"{type(e).__name__}: {e}")
+            return
+        if got is not None:
+            self._leader_hint = self._holder
+            if not self._is_leader:
+                prev = cur.holder if (cur is not None and
+                                      cur.holder != self._holder) else ""
+                self._become_leader(previous=prev, journal=got.journal)
+        else:
+            self._leader_hint = cur.holder if cur is not None else ""
+            if self._is_leader:
+                self._step_down()
+
+    def _become_leader(self, previous: str, journal: str) -> None:
+        self._is_leader = True
+        self._fleet_obs["leader"].set(1.0)
+        if previous:
+            self._fleet_obs["handoff"].inc()
+            _log.warning("leader_takeover", holder=self._holder,
+                         previous=previous)
+        else:
+            _log.info("leader_elected", holder=self._holder)
+        # rebuild membership a dead leader knew about (heartbeats to
+        # all routers usually made this a no-op already)
+        self._restore_members()
+        pending: List[str] = []
+        if journal:
+            try:
+                pending = [str(k) for k in
+                           (json.loads(journal).get("roll") or [])]
+            except ValueError:
+                pending = []
+        if pending:
+            # the previous leader died mid-roll; finish what it started
+            _log.warning("resuming_interrupted_roll", pending=pending)
+            threading.Thread(target=self._resume_roll, args=(pending,),
+                             name="pio-fleet-roll-resume",
+                             daemon=True).start()
+
+    def _step_down(self) -> None:
+        self._is_leader = False
+        self._fleet_obs["leader"].set(0.0)
+        _log.warning("leader_stepped_down", holder=self._holder,
+                     leader=self._leader_hint)
+
+    def _lease_loop(self) -> None:
+        interval = max(self.fleet.lease_ttl_s / 3.0, 0.02)
+        while not self._lease_stop.wait(interval):
+            self._lease_tick()
+
+    def _journal_roll(self, pending: List[str]) -> None:
+        """Record the members still to roll in the lease row (renewing
+        the lease as a side effect); an empty list clears the journal."""
+        if self._leases is None or not self._is_leader:
+            return
+        payload = json.dumps({"roll": pending}) if pending else ""
+        try:
+            self._leases.acquire(self._lease_name, self._holder,
+                                 self.fleet.lease_ttl_s, journal=payload)
+        except Exception as e:
+            _log.warning("roll_journal_write_failed",
+                         error=f"{type(e).__name__}: {e}")
+
+    def _resume_roll(self, pending: List[str]) -> None:
+        try:
+            report = self.rolling_reload(only=pending)
+            _log.info("roll_resumed", aborted=report["aborted"],
+                      results=len(report["results"]))
+        except HTTPError as e:
+            # 409: an operator roll beat us; 503: lost the lease again
+            _log.warning("roll_resume_not_run", error=e.message)
+
+    # -- membership ---------------------------------------------------------
+    def _find_member(self, key: str) -> Optional[_Replica]:
+        for rep in list(self._replicas):
+            if rep.key == key:
+                return rep
+        return None
+
+    def _add_member(self, host: str, port: int) -> _Replica:
+        with self._members_lock:
+            for rep in self._replicas:
+                if rep.host == host and rep.port == port:
+                    return rep
+            rep = _Replica(len(self._replicas), server=None,
+                           host=host, port=port)
+            self._replicas.append(rep)
+        self._update_gauges()
+        return rep
+
+    def _members_blob_id(self) -> str:
+        return _MEMBERS_BLOB_PREFIX + (self.config.engine_variant
+                                       or "default")
+
+    def _persist_members(self) -> None:
+        """Snapshot the remote membership into the model store, so a
+        restarted router re-admits remote replicas immediately instead
+        of waiting a full re-registration interval."""
+        remote = [{"member": r.key, "model": r.model_id}
+                  for r in list(self._replicas) if r.remote]
+        try:
+            self.ctx.registry.get_model_data_models().insert(Model(
+                self._members_blob_id(),
+                json.dumps({"members": remote}).encode()))
+        except Exception as e:
+            _log.warning("member_snapshot_write_failed",
+                         error=f"{type(e).__name__}: {e}")
+
+    def _restore_members(self) -> None:
+        try:
+            blob = self.ctx.registry.get_model_data_models().get(
+                self._members_blob_id())
+        except Exception as e:
+            _log.warning("member_snapshot_read_failed",
+                         error=f"{type(e).__name__}: {e}")
+            return
+        if blob is None:
+            return
+        try:
+            entries = json.loads(bytes(blob.models)).get("members", [])
+        except (ValueError, TypeError):
+            return
+        for entry in entries:
+            member = str(entry.get("member", ""))
+            host, sep, port_s = member.rpartition(":")
+            if not sep or not host or not port_s.isdigit():
+                continue
+            if self._find_member(member) is not None:
+                continue
+            rep = self._add_member(host, int(port_s))  # lint: ok — host str
+            rep.model_id = str(entry.get("model", ""))
+            if self._probe(rep):
+                rep.beat()
+                self._admit(rep)
+            _log.info("member_restored", member=member,
+                      admitted=rep.admitted)
+
+    def _handle_beat(self, req: Request, register: bool) -> Response:
+        try:
+            body = req.json()
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        member = str(body.get("member", ""))
+        host, sep, port_s = member.rpartition(":")
+        if not sep or not host or not port_s.isdigit():
+            raise HTTPError(400, "member must be 'host:port'")
+        # partition seam: an armed rule means this beat never arrived
+        if faults().dropped(f"fleet.net.{member}.heartbeat"):
+            raise HTTPError(503, "heartbeat dropped (injected partition)")
+        rep = self._find_member(member)
+        if rep is None:
+            # /fleet/heartbeat auto-registers too: a router restarted
+            # from scratch re-learns the fleet within one beat
+            rep = self._add_member(host, int(port_s))  # lint: ok — host str
+            self._fleet_obs["transitions"].labels(event="register").inc()
+            _log.info("member_registered", member=member,
+                      explicit=register)
+            self._persist_members()
+        rep.beat(model_id=str(body.get("model", "")))
+        ready = bool(body.get("ready", True))
+        with rep.lock:
+            busy = rep.state in ("reloading", "stopping")
+            if rep.state == "dead":
+                rep.state = "starting"
+        if not busy:
+            if ready:
+                self._maybe_admit(rep)
+            else:
+                self._eject(rep, "member reported not ready")
+        return Response.json({
+            "member": member, "admitted": rep.admitted,
+            "leader": self._leader_hint,
+            "heartbeat_s": self.fleet.effective_heartbeat_s()})
 
     # -- health gating ------------------------------------------------------
+    def _grace_s(self) -> float:
+        # a member is only eject-stale once it has missed ~3 beats
+        return 3.0 * self.fleet.effective_heartbeat_s()
+
     def _probe(self, rep: _Replica) -> bool:
+        if faults().dropped(f"fleet.net.{rep.key}.heartbeat"):
+            return False          # partition: the probe never lands
         try:
             req = urllib.request.Request(
-                f"http://127.0.0.1:{rep.port}/ready", method="GET")
+                f"http://{rep.host}:{rep.port}/ready", method="GET")
             with urllib.request.urlopen(req, timeout=2) as resp:
                 return resp.status == 200
         except urllib.error.HTTPError:
@@ -210,45 +586,71 @@ class FleetServer(HTTPServerBase):
             self._fleet_obs["transitions"].labels(event="admit").inc()
         self._update_gauges()
 
+    def _maybe_admit(self, rep: _Replica) -> None:
+        """Admit on positive health evidence (good probe, ready beat) —
+        UNLESS the member is in post-eject quarantine. Without the
+        quarantine a data-path-partitioned member would flap: its
+        heartbeats and control-path probes look healthy, so every beat
+        would re-admit what routing just ejected."""
+        with rep.lock:
+            quarantined = (rep.ejected_at > 0.0 and
+                           time.monotonic() - rep.ejected_at
+                           < self._grace_s())
+        if not quarantined:
+            self._admit(rep)
+
     def _eject(self, rep: _Replica, reason: str) -> None:
         with rep.lock:
             was = rep.admitted
             rep.admitted = False
+            rep.ejected_at = time.monotonic()
             if rep.state == "serving":
                 rep.state = "ejected"
         if was:
             self._fleet_obs["transitions"].labels(event="eject").inc()
             _log.warning("replica_ejected", replica=rep.index,
-                         reason=reason)
+                         member=rep.key, reason=reason)
         self._update_gauges()
 
-    def _record_failure(self, rep: _Replica, reason: str) -> None:
+    def _record_failure(self, rep: _Replica, reason: str,
+                        data_path: bool = False) -> None:
+        """One suspicion. Data-path evidence (routing saw a connection
+        error or 5xx) ejects at the threshold alone; probe-only
+        suspicion additionally needs a stale heartbeat, so a member
+        whose control path flaps while its beats keep arriving is not
+        bounced out of rotation."""
         with rep.lock:
             rep.failures += 1
             over = rep.failures >= self.fleet.eject_threshold
-        if over:
+            stale = (time.monotonic() - rep.last_beat) >= self._grace_s()
+        if over and (data_path or stale):
             self._eject(rep, reason)
 
     def _monitor_loop(self) -> None:
         while not self._monitor_stop.wait(self.fleet.health_interval_s):
-            for rep in self._replicas:
+            for rep in list(self._replicas):
                 with rep.lock:
                     skip = rep.state in ("reloading", "stopping")
+                self._fleet_obs["beat_age"].labels(
+                    member=rep.key).set(rep.beat_age())
                 if skip:
                     continue
                 if self._probe(rep):
-                    self._admit(rep)
+                    rep.beat()
+                    self._maybe_admit(rep)
                 else:
                     self._record_failure(rep, "readiness probe failed")
 
     def _update_gauges(self) -> None:
-        admitted = sum(1 for r in self._replicas if r.admitted)
+        members = list(self._replicas)
+        admitted = sum(1 for r in members if r.admitted)
         self._fleet_obs["admitted"].set(float(admitted))  # lint: ok — host int
-        self._fleet_obs["size"].set(float(len(self._replicas)))
+        self._fleet_obs["size"].set(float(len(members)))
+        self._fleet_obs["members"].set(float(len(members)))
 
     # -- routing ------------------------------------------------------------
     def _rotation(self) -> List[_Replica]:
-        """Admitted replicas, round-robin rotated so consecutive
+        """Admitted members, round-robin rotated so consecutive
         requests spread; the non-admitted are excluded entirely."""
         admitted = [r for r in self._replicas if r.admitted]
         if not admitted:
@@ -260,11 +662,13 @@ class FleetServer(HTTPServerBase):
 
     def _proxy(self, rep: _Replica, req: Request, timeout: float
                ) -> Response:
-        """Forward one request to one replica. An HTTP error status is
-        a RESPONSE (the replica is alive and answered — pass it
+        """Forward one request to one member. An HTTP error status is
+        a RESPONSE (the member is alive and answered — pass it
         through); only transport-level failures raise OSError to the
         retry loop."""
-        url = f"http://127.0.0.1:{rep.port}{req.path}"
+        if faults().dropped(f"fleet.net.{rep.key}.data"):
+            raise OSError(f"injected partition: fleet.net.{rep.key}.data")
+        url = f"http://{rep.host}:{rep.port}{req.path}"
         headers = {}
         for name in _FORWARD_HEADERS:
             v = req.header(name)
@@ -287,10 +691,19 @@ class FleetServer(HTTPServerBase):
                     "Content-Type", "application/json"))
 
     def _route(self, req: Request) -> Response:
-        """Route to an admitted replica; connection-level failures are
-        retried on the NEXT admitted replica (zero failed client
-        requests when a replica dies), each failure feeding the
-        ejection counter."""
+        """Route to an admitted member; connection-level failures are
+        retried on the NEXT admitted member (zero failed client
+        requests when a member dies), each failure feeding the
+        ejection counter. Non-leaders redirect to the leader."""
+        if not self._is_leader:
+            leader = self._leader_hint
+            if leader and leader != self._advertise:
+                self._fleet_obs["routed"].labels(outcome="redirected").inc()
+                raise HTTPError(
+                    307, f"not the fleet leader; try {leader}",
+                    headers={"Location": f"http://{leader}{req.path}"})
+            raise HTTPError(503, "no fleet leader elected",
+                            headers={"Retry-After": "1"})
         deadline = current_deadline()
         rotation = self._rotation()
         if not rotation:
@@ -302,8 +715,13 @@ class FleetServer(HTTPServerBase):
             timeout = self.fleet.proxy_timeout_s
             if deadline is not None:
                 remaining = deadline.remaining()
-                if remaining <= 0:
-                    break   # let the deadline middleware answer 504
+                if remaining <= 0.005:
+                    # the budget is spent: shed with 504 BEFORE dialing
+                    # rather than burning a connection on a doomed call
+                    self._shed_counter.labels(surface="deadline").inc()
+                    raise DeadlineExceeded(
+                        "deadline budget exhausted before dialing a "
+                        "replica")
                 timeout = min(timeout, remaining)
             with rep.lock:
                 rep.inflight += 1
@@ -312,17 +730,19 @@ class FleetServer(HTTPServerBase):
             except OSError as e:
                 last_err = e
                 self._record_failure(
-                    rep, f"route error: {type(e).__name__}: {e}")
+                    rep, f"route error: {type(e).__name__}: {e}",
+                    data_path=True)
                 self._fleet_obs["routed"].labels(outcome="retried").inc()
                 continue
             finally:
                 with rep.lock:
                     rep.inflight -= 1
             if resp.status >= 500:
-                # the replica answered; pass the response through but
-                # feed the error threshold (a replica shedding 503s or
+                # the member answered; pass the response through but
+                # feed the error threshold (a member shedding 503s or
                 # erroring 500s should leave rotation until it recovers)
-                self._record_failure(rep, f"HTTP {resp.status}")
+                self._record_failure(rep, f"HTTP {resp.status}",
+                                     data_path=True)
             else:
                 with rep.lock:
                     rep.failures = 0
@@ -350,17 +770,27 @@ class FleetServer(HTTPServerBase):
             return rep.inflight == 0
 
     def _reload_replica(self, rep: _Replica) -> dict:
-        """POST /reload on one replica (its own last-good rollback and
-        warm_deploy run inside). Transport failure -> 'died'."""
+        """POST /reload on one member (its own last-good rollback and
+        warm_deploy run inside). Transport failure -> 'died'. The call
+        budget is reload_timeout_s, clamped to any remaining request
+        deadline so an operator's bounded /reload stays bounded."""
+        timeout = self.fleet.reload_timeout_s
+        deadline = current_deadline()
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0.005:
+                return {"status": 0,
+                        "detail": "deadline exhausted before reload dial"}
+            timeout = min(timeout, remaining)
         headers = {}
         if self.config.server_key:
             headers["Authorization"] = "Basic " + base64.b64encode(
                 f"{self.config.server_key}:".encode()).decode()
         req = urllib.request.Request(
-            f"http://127.0.0.1:{rep.port}/reload", data=b"",
+            f"http://{rep.host}:{rep.port}/reload", data=b"",
             method="POST", headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=120) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return {"status": resp.status}
         except urllib.error.HTTPError as e:
             detail = ""
@@ -372,19 +802,46 @@ class FleetServer(HTTPServerBase):
         except OSError as e:
             return {"status": 0, "detail": f"{type(e).__name__}: {e}"}
 
-    def rolling_reload(self) -> dict:
-        """One replica at a time: eject -> drain -> reload -> probe ->
-        re-admit -> next. See the module docstring for the failure
-        policy (dead replica: continue; failed load: abort)."""
+    def rolling_reload(self, only: Optional[List[str]] = None) -> dict:
+        """One member at a time: eject -> drain -> reload -> probe ->
+        re-admit -> next. Leader-only (the lease guarantees at most one
+        roller fleet-wide); progress is journaled through the lease row
+        so the next leader resumes an interrupted roll. See the module
+        docstring for the failure policy (dead member: continue;
+        unreachable member: skip; failed load: abort)."""
+        if not self._is_leader:
+            raise HTTPError(
+                503, f"not the fleet leader "
+                     f"(leader: {self._leader_hint or 'unknown'}); only "
+                     f"the lease holder may run a rolling reload")
         if not self._reload_lock.acquire(blocking=False):
             raise HTTPError(409, "a rolling reload is already running")
         try:
+            members = list(self._replicas)
+            if only is not None:
+                wanted = set(only)
+                members = [m for m in members if m.key in wanted]
             results: List[dict] = []
             aborted = False
-            for rep in self._replicas:
-                if not rep.server.is_running():
+            pending = [m.key for m in members]
+            for rep in members:
+                # journal BEFORE touching the member: a leader dying
+                # here leaves `rep` pending, so the standby re-rolls it
+                self._journal_roll(pending)
+                if rep.server is not None and not rep.server.is_running():
                     results.append({"replica": rep.index,
                                     "outcome": "skipped_dead"})
+                    pending.remove(rep.key)
+                    continue
+                if not rep.admitted and not self._probe(rep):
+                    # partitioned-but-maybe-alive: it is already out of
+                    # routing; do NOT roll what we cannot reach (its
+                    # agent re-registers and the monitor re-admits it
+                    # on heal, still on the model it last loaded)
+                    results.append({"replica": rep.index,
+                                    "member": rep.key,
+                                    "outcome": "skipped_unreachable"})
+                    pending.remove(rep.key)
                     continue
                 with rep.lock:
                     rep.admitted = False
@@ -397,6 +854,7 @@ class FleetServer(HTTPServerBase):
                 if outcome["status"] == 200:
                     ok = self._probe(rep)
                     if ok:
+                        rep.beat()
                         self._admit(rep)
                     else:
                         with rep.lock:
@@ -406,9 +864,9 @@ class FleetServer(HTTPServerBase):
                         "outcome": "reloaded" if ok else "reloaded_not_ready",
                         "drained": drained})
                 elif outcome["status"] == 0:
-                    # transport failure: the replica died mid-reload.
+                    # transport failure: the member died mid-reload.
                     # Leave it ejected — the monitor re-admits if it
-                    # ever comes back — and keep rolling: N-1 replicas
+                    # ever comes back — and keep rolling: N-1 members
                     # are still serving the old or new model.
                     with rep.lock:
                         rep.state = "dead"
@@ -419,10 +877,10 @@ class FleetServer(HTTPServerBase):
                                     "outcome": "died",
                                     "detail": outcome.get("detail", "")})
                 else:
-                    # the replica answered non-200: the LOAD failed and
+                    # the member answered non-200: the LOAD failed and
                     # its last-good rollback kept the old model serving.
                     # Re-admit it and ABORT — the new model is bad and
-                    # would fail identically on every remaining replica.
+                    # would fail identically on every remaining member.
                     if self._probe(rep):
                         self._admit(rep)
                     results.append({"replica": rep.index,
@@ -430,6 +888,10 @@ class FleetServer(HTTPServerBase):
                                     "detail": outcome.get("detail", "")})
                     aborted = True
                     break
+                pending.remove(rep.key)
+            # roll finished (or deterministically aborted): clear the
+            # journal so the next leader does not replay it
+            self._journal_roll([])
             report = {"results": results, "aborted": aborted}
             self._fleet_obs["rolls"].labels(
                 outcome="aborted" if aborted else "ok").inc()
@@ -447,24 +909,38 @@ class FleetServer(HTTPServerBase):
         def queries(req: Request) -> Response:
             return self._route(req)
 
+        @r.post("/fleet/register")
+        def fleet_register(req: Request) -> Response:
+            self.auth.check(req)
+            return self._handle_beat(req, register=True)
+
+        @r.post("/fleet/heartbeat")
+        def fleet_heartbeat(req: Request) -> Response:
+            self.auth.check(req)
+            return self._handle_beat(req, register=False)
+
         @r.get("/status.json")
         def status(req: Request) -> Response:
             return Response.json({
                 "status": "alive",
                 "role": "fleet",
+                "leader": self._is_leader,
+                "leaderHint": self._leader_hint,
+                "advertise": self._advertise,
                 "replicas": [rep.snapshot() for rep in self._replicas],
             })
 
         @r.get("/")
         def index(req: Request) -> Response:
             rows = "".join(
-                f"<tr><td>{s['replica']}</td><td>{s['port']}</td>"
+                f"<tr><td>{s['replica']}</td><td>{s['member']}</td>"
                 f"<td>{s['state']}</td><td>{s['failures']}</td></tr>"
                 for s in (rep.snapshot() for rep in self._replicas))
+            role = "leader" if self._is_leader else "standby"
             return Response.html(
                 "<html><head><title>PredictionIO-TPU fleet</title></head>"
-                "<body><h1>Fleet control plane</h1>"
-                "<table><tr><th>replica</th><th>port</th><th>state</th>"
+                f"<body><h1>Fleet control plane ({role})</h1>"
+                "<table><tr><th>member</th><th>address</th><th>state</th>"
                 f"<th>failures</th></tr>{rows}</table></body></html>")
 
         @r.post("/reload")
@@ -481,22 +957,121 @@ class FleetServer(HTTPServerBase):
             return Response.json({"message": "Fleet shutting down"})
 
 
+class ReplicaAgent:
+    """Sidecar loop for a standalone replica (`pio-tpu deploy --join
+    http://router:8000[,http://standby:8000]`): registers the local
+    PredictionServer with every router URL, then heartbeats
+    {member, model, ready} each `heartbeat_s`. Beating ALL routers —
+    leader and standbys alike — keeps every membership table warm, so
+    a standby that wins the lease can route instantly. `/fleet/
+    heartbeat` auto-registers, so a router restarted from scratch
+    re-learns this replica within one beat."""
+
+    def __init__(self, server: PredictionServer, routers: Sequence[str],
+                 advertise: str = "", server_key: str = "",
+                 heartbeat_s: float = 0.0):
+        self.server = server
+        self.routers = [u.rstrip("/") for u in routers if u]
+        self.advertise = advertise
+        self.server_key = server_key
+        self.heartbeat_s = heartbeat_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._router_down: Dict[str, bool] = {}
+
+    def start(self) -> None:
+        if not self.advertise:
+            self.advertise = f"127.0.0.1:{self.server.port}"
+        if self._beat_all("/fleet/register", first=True) == 0:
+            _log.warning("fleet_register_failed_everywhere",
+                         routers=",".join(self.routers))
+        if self.heartbeat_s <= 0:
+            self.heartbeat_s = 1.0
+        self._thread = threading.Thread(
+            target=self._loop, name="pio-replica-agent", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _payload(self) -> bytes:
+        try:
+            ready, _ = self.server.readiness()
+        except Exception:
+            ready = False
+        return json.dumps({"member": self.advertise,
+                           "model": self.server.current_instance_id(),
+                           "ready": bool(ready)}).encode()
+
+    def _post(self, url: str, data: bytes) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.server_key:
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                f"{self.server_key}:".encode()).decode()
+        req = urllib.request.Request(url, data=data, method="POST",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=3) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def _beat_all(self, path: str, first: bool = False) -> int:
+        data = self._payload()
+        ok = 0
+        for router in self.routers:
+            try:
+                out = self._post(router + path, data)
+            except (OSError, ValueError) as e:
+                # log edges, not every missed beat
+                if not self._router_down.get(router):
+                    _log.warning("fleet_router_unreachable", router=router,
+                                 error=f"{type(e).__name__}: {e}")
+                self._router_down[router] = True
+                continue
+            if self._router_down.get(router):
+                _log.info("fleet_router_reachable_again", router=router)
+            self._router_down[router] = False
+            ok += 1
+            if first and self.heartbeat_s <= 0:
+                hb = float(out.get("heartbeat_s") or 0)  # lint: ok — host json scalar
+                if hb > 0:
+                    self.heartbeat_s = hb
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            self._beat_all("/fleet/heartbeat")
+
+
 def _fleet_metrics(metrics: MetricsRegistry):
     return {
         "routed": metrics.counter(
             "pio_fleet_routed_total",
-            "Router outcomes (ok/retried/no_replica/exhausted)",
+            "Router outcomes (ok/retried/redirected/no_replica/exhausted)",
             labels=("outcome",)),
         "transitions": metrics.counter(
             "pio_fleet_transitions_total",
-            "Replica lifecycle events (admit/eject/reload_start)",
+            "Member lifecycle events (admit/eject/register/reload_start)",
             labels=("event",)),
         "rolls": metrics.counter(
             "pio_fleet_rolling_reload_total",
             "Rolling reloads by outcome", labels=("outcome",)),
         "admitted": metrics.gauge(
             "pio_fleet_replicas_admitted",
-            "Replicas currently admitted to routing"),
+            "Members currently admitted to routing"),
         "size": metrics.gauge(
-            "pio_fleet_replicas_total", "Replicas managed by the fleet"),
+            "pio_fleet_replicas_total", "Members managed by the fleet"),
+        "members": metrics.gauge(
+            "pio_fleet_members",
+            "Members in the routing table (in-process + remote)"),
+        "leader": metrics.gauge(
+            "pio_fleet_leader",
+            "1 while this router holds the fleet leadership lease"),
+        "handoff": metrics.counter(
+            "pio_fleet_handoff_total",
+            "Leadership handoffs (lease taken over from a dead holder)"),
+        "beat_age": metrics.gauge(
+            "pio_fleet_heartbeat_age_seconds",
+            "Seconds since each member's last heartbeat or healthy probe",
+            labels=("member",)),
     }

@@ -614,6 +614,13 @@ class PredictionServer(HTTPServerBase):
         return (loaded and not open_breakers,
                 {"modelLoaded": loaded, "storageBreakers": states})
 
+    def current_instance_id(self) -> str:
+        """Engine-instance id of the deployment currently serving, ""
+        when none is loaded — what a fleet replica agent reports in its
+        heartbeats so the router can see model skew across members."""
+        dep = self._dep
+        return dep.instance.id if dep is not None else ""
+
     @staticmethod
     def _probe_occupant(host: str, port: int):
         """GET /status.json from whatever occupies the port. Returns the

@@ -27,6 +27,11 @@ Checks, per source file:
     a torn file; go through ``data.integrity.atomic_write_bytes`` (tmp +
     fsync + rename). Lines mentioning ``.tmp`` (the staging file of the
     atomic pattern itself) or marked ``# lint: ok`` are allowed
+  - resilient layers (serving/, data/) must pass an explicit
+    ``timeout=`` to every ``urllib.request.urlopen(`` call — the
+    default is "wait forever", and a hung peer (partitioned replica,
+    dead router) then strands the calling thread with it; derive the
+    bound from the remaining deadline budget where one exists
   - device serve hot paths (ops/topk.py, serving/) must not coerce with
     ``np.asarray``/``np.array`` or bare ``float()``/``int()`` — on a jax
     array each is an implicit device->host transfer that blocks the
@@ -233,6 +238,38 @@ def _check_bounded_waits(tree: ast.AST, text: str,
                    "legitimate fixed waits")
 
 
+def _check_urlopen_timeout(tree: ast.AST, text: str,
+                           rel: str) -> Iterator[str]:
+    """In serving/ and data/: every ``urlopen(`` must carry an explicit
+    ``timeout=`` kwarg. urllib's default is socket-global (usually
+    None = block forever), so a partitioned peer that accepts the TCP
+    connection and then goes silent strands the caller — on the fleet
+    data path that means a router thread gone for good. The bound
+    should come from the remaining deadline budget when the call is on
+    a request path (``min(cap, deadline.remaining())``). ``# lint: ok``
+    on the line is the escape hatch."""
+    if not rel.startswith(_RESILIENT_DIRS):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name != "urlopen":
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        yield (f"{rel}:{node.lineno}: urlopen() without timeout= blocks "
+               "forever on a silent peer; pass an explicit bound "
+               "(deadline-derived on request paths), or mark "
+               "'# lint: ok'")
+
+
 def _check_storage_writes(tree: ast.AST, text: str,
                           rel: str) -> Iterator[str]:
     """In data/storage/: forbid direct ``.write_bytes()``/``.write_text()``
@@ -366,6 +403,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_lines(text, rel))
     out.extend(_check_instrumentation(tree, text, rel))
     out.extend(_check_bounded_waits(tree, text, rel))
+    out.extend(_check_urlopen_timeout(tree, text, rel))
     out.extend(_check_storage_writes(tree, text, rel))
     out.extend(_check_device_transfers(tree, text, rel))
     out.extend(_check_training_reads(tree, text, rel))

@@ -1,0 +1,510 @@
+"""Cross-host fleet chaos suite: remote membership, lease-based leader
+handoff, partition-tolerant routing (the PR-8 layer).
+
+Every scenario runs real HTTP on loopback with aggressive timings
+(lease TTL ~0.5s, heartbeats ~0.1s):
+
+  - remote replicas (standalone PredictionServer + ReplicaAgent) join a
+    router-only control plane over POST /fleet/register and serve real
+    queries through it
+  - two routers racing for the leadership lease: exactly ONE wins, and
+    a graceful stop releases the lease to the loser
+  - split-brain prevention: a non-leader 307-redirects /queries.json to
+    the leader and refuses /reload with 503 — only the lease holder
+    ever rolls the fleet
+  - heartbeat-partition (armed `fleet.net.<member>.heartbeat` seam): the
+    member is ejected from routing but NOT rolled (skipped_unreachable),
+    and re-admitted when the partition heals
+  - the ISSUE centerpiece: the leader crashes mid-rolling-reload (no
+    lease release), the standby takes over on TTL expiry, inherits the
+    roll journal from the lease row, finishes the roll — and clients
+    that fail over between routers see ZERO ultimately-failed requests
+  - membership snapshot persistence: a restarted router re-admits a
+    remote replica immediately, without waiting for re-registration
+  - the _route deadline clamp: a request whose budget is spent mid-
+    rotation is shed 504 BEFORE dialing the next replica
+    (`pio_shed_total{surface="deadline"}`)
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.serving import (
+    FleetConfig, FleetServer, PredictionServer, ReplicaAgent, ServerConfig,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with the chaos harness disarmed."""
+    faults().clear()
+    yield
+    faults().clear()
+
+
+def call(port, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _metric(name, **labels):
+    return get_registry().value(name, **labels)
+
+
+def _wait(pred, timeout=8.0, interval=0.02, msg="condition"):
+    end = time.perf_counter() + timeout
+    while time.perf_counter() < end:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for: {msg}")
+
+
+@pytest.fixture()
+def trained(mem_registry):
+    """Registry with a trained recommendation instance."""
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "xhostapp"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey("XKEY", app_id, ()))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="xhostapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4,
+                                           seed=1)),))
+    CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine
+
+
+def _start_router(trained, standby=False, replicas=0, **fleet_kw):
+    """Router (leader candidate or standby) with chaos-grade timings."""
+    registry, engine = trained
+    fleet_kw.setdefault("health_interval_s", 0.1)
+    fleet_kw.setdefault("heartbeat_s", 0.1)
+    fleet_kw.setdefault("eject_threshold", 2)
+    fleet_kw.setdefault("drain_timeout_s", 2.0)
+    fleet_kw.setdefault("lease_ttl_s", 0.5)
+    srv = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0),
+        FleetConfig(replicas=replicas, standby=standby, **fleet_kw),
+        registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+def _start_replica(trained, routers, heartbeat_s=0.1):
+    """Standalone replica + the self-registration agent (`--join`)."""
+    registry, engine = trained
+    srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                           registry=registry, engine=engine)
+    srv.start()
+    agent = ReplicaAgent(
+        srv, [f"http://127.0.0.1:{r.port}" for r in routers],
+        heartbeat_s=heartbeat_s)
+    agent.start()
+    return srv, agent
+
+
+def _admitted(router, member):
+    m = router._find_member(member)
+    return m is not None and m.admitted
+
+
+class _FailoverLoader:
+    """Client hammer that fails over between routers the way a real
+    fleet client does: try each router, follow 307 redirects, retry
+    503s — a request only counts as FAILED when no router serves it
+    within its budget."""
+
+    def __init__(self, ports, threads=2, budget_s=10.0):
+        self.ports = list(ports)
+        self.budget_s = budget_s
+        self.halt = threading.Event()
+        self.statuses = []
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(threads)]
+
+    def _attempt(self, port):
+        try:
+            return call(port, "POST", "/queries.json",
+                        {"user": "u1", "num": 2})
+        except OSError:
+            return -1, None
+
+    def _one_request(self):
+        end = time.perf_counter() + self.budget_s
+        while time.perf_counter() < end and not self.halt.is_set():
+            for port in self.ports:
+                status, body = self._attempt(port)
+                if status == 200:
+                    return 200
+                if status == 307:
+                    # follow the leader redirect by hand (urllib does
+                    # not re-POST on 307)
+                    continue
+            time.sleep(0.05)
+        return -1
+
+    def _run(self):
+        while not self.halt.is_set():
+            status = self._one_request()
+            if self.halt.is_set() and status != 200:
+                return              # torn down mid-request: not a failure
+            with self._lock:
+                self.statuses.append(status)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    @property
+    def failures(self):
+        with self._lock:
+            return [s for s in self.statuses if s != 200]
+
+
+class TestRemoteMembership:
+    def test_remote_replica_registers_and_serves(self, trained):
+        router = _start_router(trained)
+        rep, agent = _start_replica(trained, [router])
+        try:
+            member = agent.advertise
+            _wait(lambda: _admitted(router, member),
+                  msg="remote member admitted")
+            for _ in range(4):
+                status, body = call(router.port, "POST", "/queries.json",
+                                    {"user": "u1", "num": 3})
+                assert status == 200 and len(body["itemScores"]) == 3
+            status, body = call(router.port, "GET", "/status.json")
+            assert status == 200 and body["leader"] is True
+            snap = [r for r in body["replicas"] if r["member"] == member]
+            assert snap and snap[0]["remote"] and snap[0]["model"]
+            assert _metric("pio_fleet_members") >= 1.0
+        finally:
+            agent.stop()
+            rep.stop()
+            router.stop()
+
+    def test_member_snapshot_readmits_after_router_restart(self, trained):
+        """Satellite: membership survives a router restart through the
+        model-store snapshot — no re-registration wait."""
+        registry, engine = trained
+        rep = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                               registry=registry, engine=engine)
+        rep.start()
+        member = f"127.0.0.1:{rep.port}"
+        router = _start_router(trained)
+        try:
+            status, body = call(router.port, "POST", "/fleet/register",
+                                {"member": member, "ready": True})
+            assert status == 200 and body["admitted"] is True
+        finally:
+            router.stop()
+        # a brand-new router process: no agent heartbeat ever reaches
+        # it before start() returns, yet the member is already admitted
+        router2 = _start_router(trained)
+        try:
+            assert _admitted(router2, member)
+            status, body = call(router2.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200
+        finally:
+            router2.stop()
+            rep.stop()
+
+
+class TestLeaderLease:
+    def test_two_routers_race_exactly_one_leader(self, trained):
+        routers = []
+        lock = threading.Lock()
+
+        def mk():
+            r = _start_router(trained)
+            with lock:
+                routers.append(r)
+
+        threads = [threading.Thread(target=mk) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        try:
+            assert len(routers) == 2
+            assert sum(1 for r in routers if r.is_leader()) == 1
+            # stays settled across several renew cycles
+            time.sleep(0.8)
+            assert sum(1 for r in routers if r.is_leader()) == 1
+            # graceful stop RELEASES the lease: the loser takes over
+            # without waiting out the TTL
+            lead = next(r for r in routers if r.is_leader())
+            other = next(r for r in routers if r is not lead)
+            lead.stop()
+            _wait(other.is_leader, msg="survivor takes released lease")
+        finally:
+            for r in routers:
+                r.stop()
+
+    def test_nonleader_redirects_queries_and_refuses_reload(self, trained):
+        leader = _start_router(trained)
+        standby = _start_router(trained, standby=True)
+        rep, agent = _start_replica(trained, [leader, standby])
+        try:
+            member = agent.advertise
+            _wait(lambda: _admitted(leader, member) and
+                  _admitted(standby, member),
+                  msg="member admitted on both routers")
+            assert leader.is_leader() and not standby.is_leader()
+            # split-brain guard 1: the standby refuses to roll
+            status, body = call(standby.port, "POST", "/reload")
+            assert status == 503 and "leader" in body["message"]
+            # split-brain guard 2: queries at the standby are redirected
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{standby.port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 2}).encode(),
+                method="POST", headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 307
+            loc = err.value.headers["Location"]
+            assert str(leader.port) in loc
+            # following the redirect by hand reaches the leader
+            status, body = call(leader.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200
+            # the leader CAN roll — through the remote member's real
+            # /reload — and the member comes back admitted
+            status, report = call(leader.port, "POST", "/reload")
+            assert status == 200 and report["aborted"] is False
+            assert [r["outcome"] for r in report["results"]] == ["reloaded"]
+            _wait(lambda: _admitted(leader, member),
+                  msg="member re-admitted after roll")
+        finally:
+            agent.stop()
+            rep.stop()
+            standby.stop()
+            leader.stop()
+
+
+class TestPartitionTolerance:
+    def test_heartbeat_partition_ejected_not_rolled_readmitted(self,
+                                                               trained):
+        """Armed `fleet.net.<member>.heartbeat`: probes and heartbeats
+        vanish, the member leaves rotation — but a rolling reload SKIPS
+        it instead of rolling a box it cannot see, and the first healthy
+        probe after heal re-admits it."""
+        router = _start_router(trained)
+        rep, agent = _start_replica(trained, [router])
+        try:
+            member = agent.advertise
+            _wait(lambda: _admitted(router, member), msg="member admitted")
+            faults().arm(f"fleet.net.{member}.heartbeat")
+            _wait(lambda: not _admitted(router, member),
+                  msg="partitioned member ejected")
+            # the member is alive and serving — only unreachable
+            status, _ = call(rep.port, "GET", "/ready")
+            assert status == 200
+            report = router.rolling_reload()
+            assert report["aborted"] is False
+            outcomes = {r.get("member", ""): r["outcome"]
+                        for r in report["results"]}
+            assert outcomes.get(member) == "skipped_unreachable"
+            # heal: the monitor re-admits on the first good probe
+            faults().clear()
+            _wait(lambda: _admitted(router, member),
+                  msg="member re-admitted after heal")
+        finally:
+            agent.stop()
+            rep.stop()
+            router.stop()
+
+    def test_data_partition_retries_cost_clients_nothing(self, trained):
+        """Armed `fleet.net.<member>.data`: the proxy path to one member
+        drops while its heartbeats keep flowing. Routing retries on the
+        next member and ejects the unroutable one on data-path
+        evidence alone; clients never see a failure."""
+        router = _start_router(trained)
+        rep1, agent1 = _start_replica(trained, [router])
+        rep2, agent2 = _start_replica(trained, [router])
+        try:
+            m1, m2 = agent1.advertise, agent2.advertise
+            _wait(lambda: _admitted(router, m1) and _admitted(router, m2),
+                  msg="both members admitted")
+            faults().arm(f"fleet.net.{m1}.data")
+
+            def hammer_until_ejected():
+                # keep traffic flowing: ejection needs routing evidence
+                status, _ = call(router.port, "POST", "/queries.json",
+                                 {"user": "u1", "num": 2})
+                assert status == 200
+                return not _admitted(router, m1)
+
+            _wait(hammer_until_ejected,
+                  msg="data-partitioned member ejected with zero "
+                      "client failures")
+            assert _admitted(router, m2)
+        finally:
+            agent1.stop()
+            agent2.stop()
+            rep1.stop()
+            rep2.stop()
+            router.stop()
+
+
+class TestLeaderHandoff:
+    def test_leader_crash_mid_roll_standby_finishes_zero_failures(
+            self, trained):
+        """The centerpiece: the leader dies (no lease release) while a
+        rolling reload is between members. The standby takes the lease
+        on TTL expiry, inherits the roll journal, finishes rolling every
+        pending member — and failover clients lose nothing."""
+        leader = _start_router(trained)
+        standby = _start_router(trained, standby=True)
+        rep1, agent1 = _start_replica(trained, [leader, standby])
+        rep2, agent2 = _start_replica(trained, [leader, standby])
+        members = {agent1.advertise, agent2.advertise}
+        handoffs_before = _metric("pio_fleet_handoff_total")
+        roll_started = threading.Event()
+        stall = threading.Event()
+        standby_rolled = []
+        try:
+            _wait(lambda: leader.is_leader() and not standby.is_leader(),
+                  msg="leadership settles on the first router")
+            _wait(lambda: all(_admitted(leader, m) for m in members) and
+                  all(_admitted(standby, m) for m in members),
+                  msg="members admitted on both routers")
+
+            def crash_mid_roll(rep):
+                # first member's reload call: the leader "process" dies
+                roll_started.set()
+                leader.crash()
+                stall.wait(30)
+                return {"status": 0, "detail": "leader crashed"}
+
+            leader._reload_replica = crash_mid_roll
+            orig_reload = standby._reload_replica
+
+            def record(rep):
+                standby_rolled.append(rep.key)
+                return orig_reload(rep)
+
+            standby._reload_replica = record
+
+            with _FailoverLoader([leader.port, standby.port]) as load:
+                time.sleep(0.2)                      # traffic flowing
+                roller = threading.Thread(
+                    target=lambda: _swallow(leader.rolling_reload),
+                    daemon=True)
+                roller.start()
+                assert roll_started.wait(5)
+                _wait(standby.is_leader, msg="standby takes expired lease")
+                _wait(lambda: set(standby_rolled) == members, timeout=15,
+                      msg="standby resumes and finishes the roll")
+                _wait(lambda: all(_admitted(standby, m) for m in members),
+                      msg="every member re-admitted post-roll")
+                time.sleep(0.3)                      # post-handoff traffic
+            assert load.failures == []
+            assert len(load.statuses) > 0
+            assert _metric("pio_fleet_handoff_total") == handoffs_before + 1
+            # the crashed leader can no longer touch the journal: its
+            # lease CAS fails against the new holder
+            lease = trained[0].get_leases().get(leader._lease_name)
+            assert lease is not None
+            assert lease.holder == standby._advertise
+        finally:
+            stall.set()
+            agent1.stop()
+            agent2.stop()
+            rep1.stop()
+            rep2.stop()
+            standby.stop()
+            leader.stop()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+class TestDeadlineShed:
+    def test_spent_deadline_sheds_504_before_dialing_next_replica(
+            self, trained):
+        """Satellite: the old `min(timeout, remaining)` clamp could dial
+        a replica with a ~0 timeout on the retry leg; now the spent
+        budget sheds 504 before the dial and counts in
+        pio_shed_total{surface=deadline}."""
+        registry, engine = trained
+        fleet = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0),
+            FleetConfig(replicas=2, health_interval_s=0.1,
+                        eject_threshold=10, drain_timeout_s=2.0),
+            registry=registry, engine=engine)
+        fleet.start()
+        dialed = []
+
+        def hanging_proxy(rep, req, timeout):
+            dialed.append(rep.key)
+            time.sleep(0.15)           # outlive the 100ms budget
+            raise OSError("simulated replica hang")
+
+        fleet._proxy = hanging_proxy
+        try:
+            shed_before = _metric("pio_shed_total", surface="deadline")
+            status, body = call(fleet.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2},
+                                headers={"X-PIO-Deadline-Ms": "100"})
+            assert status == 504
+            assert _metric("pio_shed_total", surface="deadline") \
+                == shed_before + 1
+            # the second admitted replica was never dialed
+            assert len(dialed) == 1
+        finally:
+            fleet.stop()

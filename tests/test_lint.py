@@ -207,6 +207,49 @@ def test_device_transfer_gate_scoped_to_hot_paths(tmp_path):
     assert not lint.run(tmp_path)
 
 
+def test_urlopen_gate_catches_unbounded_dials(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "dials.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "from urllib.request import urlopen\n"
+        "import urllib.request\n"
+        "def f(url):\n"
+        "    a = urlopen(url)\n"
+        "    b = urllib.request.urlopen(url)\n"
+        "    return a, b\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert kinds.count("urlopen() without timeout=") == 2
+
+
+def test_urlopen_gate_allows_timeouts_and_escape(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "data" / "dials.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from urllib.request import urlopen\n"
+        "def f(url, budget):\n"
+        "    a = urlopen(url, timeout=budget)\n"
+        "    b = urlopen(url)  # lint: ok\n"
+        "    return a, b\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_urlopen_gate_scoped_to_request_paths(tmp_path):
+    # tools/ scripts may block on a slow peer; only serving/data must bound
+    ok = tmp_path / "predictionio_tpu" / "tools" / "fetch.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from urllib.request import urlopen\n"
+        "def f(url):\n"
+        "    return urlopen(url)\n"
+    )
+    assert not lint.run(tmp_path)
+
+
 def test_training_read_gate_catches_find_events_in_read_training(tmp_path):
     bad = tmp_path / "predictionio_tpu" / "models" / "tmpl.py"
     bad.parent.mkdir(parents=True)
