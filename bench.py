@@ -2271,6 +2271,196 @@ def bench_seqrec(n_users: int = 20_000, n_items: int = 1_000,
     emit("seqrec_next_item_hitrate_at_10", hr, "rate", hr / phr)
 
 
+def _rss_mb() -> float:
+    """Resident set of THIS process (linux /proc; ru_maxrss fallback)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except (OSError, IndexError, ValueError):
+        pass
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_streaming_freshness():
+    """Streaming freshness acceptance run (the streaming PR's gates): a
+    PEVLOG-backed store under a live `PredictionServer` whose background
+    `Refresher` folds a steady drip of new ratings into the
+    device-resident serve plans. Hard gates, each a SystemExit on miss:
+      - p95 `pio_freshness_seconds` < refresh interval x 2
+      - ZERO steady-state recompiles across >= 10 folded hot swaps
+      - bounded RSS growth across the measured window
+      - fold-in top-10 consistent with a ground-truth full retrain
+    """
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.core import (
+        CoreWorkflow, EngineParams, RuntimeContext,
+    )
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, StorageRegistry
+    from predictionio_tpu.models import recommendation as rec
+    from predictionio_tpu.obs import compile_watch, get_registry
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+
+    interval_s = 0.4
+    n_users, n_items = 96, 48
+    rng = np.random.RandomState(11)
+
+    def _rate(u, i, v):
+        return Event(event="rate", entity_type="user", entity_id=u,
+                     target_entity_type="item", target_entity_id=i,
+                     properties=DataMap({"rating": float(v)}))
+
+    def _drip(events, app_id, size=7):
+        us = rng.choice(np.arange(1, n_users), size, replace=False)
+        batch = [_rate(f"u{u}", f"i{u % n_items}", 5.0) for u in us]
+        # the pin pair rides EVERY delta: u0/i0 carry the longest
+        # histories by a full pow2 bucket, so the fold solver's
+        # history-cap padding stays constant across the whole window
+        # (the row-count pow2 buckets are warmed explicitly below)
+        batch.append(_rate("u0", "i0", 5.0))
+        events.insert_batch(batch, app_id)
+
+    tmp = tempfile.mkdtemp(prefix="pio-bench-streaming-")
+    server = None
+    try:
+        registry = StorageRegistry({
+            "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_DB_PATH": os.path.join(tmp, "pio.db"),
+            "PIO_STORAGE_SOURCES_PEV_TYPE": "PEVLOG",
+            "PIO_STORAGE_SOURCES_PEV_PATH": os.path.join(tmp, "pevlog"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PEV",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        app_id = registry.get_meta_data_apps().insert(App(0, "streambench"))
+        events = registry.get_events()
+        events.init(app_id)
+        seed = [_rate(f"u{u}", f"i{i}", 5.0 if i % 4 == u % 4 else 1.0)
+                for u in range(n_users) for i in range(n_items)
+                if rng.rand() <= 0.35]
+        # history pins (see _drip): u0 and i0 dominate their side's
+        # longest history so the fold's cap bucket never moves
+        seed += [_rate("u0", f"i{rng.randint(n_items)}", 3.0)
+                 for _ in range(140)]
+        seed += [_rate(f"u{rng.randint(n_users)}", "i0", 3.0)
+                 for _ in range(300)]
+        events.insert_batch(seed, app_id)
+
+        engine = rec.engine()
+        params = EngineParams(
+            data_source_params=("", rec.DataSourceParams(
+                app_name="streambench")),
+            algorithm_params_list=(("als", rec.ALSAlgorithmParams(
+                rank=RANK, num_iterations=6, seed=SEED)),))
+        ctx = RuntimeContext(registry=registry)
+        CoreWorkflow.run_train(engine, params, ctx)
+
+        server = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0,
+                         refresh_interval_s=interval_s),
+            registry=registry, engine=engine)
+        server.start()
+        reg = get_registry()
+
+        def _folded():
+            return reg.value("pio_streaming_refresh_total",
+                             outcome="folded") or 0.0
+
+        for n in range(10):              # warm the serve path
+            _post(server.port, {"user": f"u{n}", "num": 10})
+        # warm every pow2 fold bucket the measured window can hit — the
+        # solver pads touched-row counts to powers of two so the jit
+        # cache is shared, but the FIRST fold at each bucket size still
+        # compiles; steady state must reuse, never build. Sizes are
+        # pow2-1 so the pin pair lands the batch exactly on a bucket.
+        for size in (7, 15, 31, 63):
+            before = _folded()
+            _drip(events, app_id, size)
+            t0 = time.perf_counter()
+            while _folded() <= before:
+                if time.perf_counter() - t0 > 30:
+                    raise SystemExit(
+                        f"streaming: warm-up fold (bucket {size + 1}) "
+                        "never landed")
+                time.sleep(0.05)
+        first = _folded()
+
+        samples = []
+        last = _folded()
+        target = last + 10
+        rss0 = _rss_mb()
+        with compile_watch() as w:
+            deadline = time.perf_counter() + 120
+            while last < target:
+                if time.perf_counter() > deadline:
+                    raise SystemExit(
+                        f"streaming: only {int(last - target + 10)}/10 "
+                        "folded ticks inside the measurement window")
+                _drip(events, app_id)
+                time.sleep(interval_s / 4)
+                now = _folded()
+                if now > last:
+                    last = now
+                    samples.append(
+                        reg.value("pio_freshness_seconds") or 0.0)
+                    # the serve path stays hot THROUGH the swaps
+                    _post(server.port, {"user": "u0", "num": 10})
+        rss1 = _rss_mb()
+
+        p95 = float(np.percentile(samples, 95))
+        emit("streaming_freshness_p95_s", p95, "s",
+             (2.0 * interval_s) / max(p95, 1e-9))
+        if p95 >= 2.0 * interval_s:
+            raise SystemExit(
+                f"streaming: freshness p95 {p95:.3f}s >= "
+                f"{2.0 * interval_s:.3f}s gate")
+        emit("streaming_steady_state_recompiles", float(w.count),
+             "count", 1.0 if w.count == 0 else 0.0)
+        if w.count:
+            raise SystemExit(
+                f"streaming: {w.count} recompiles across steady-state "
+                "hot swaps (gate: zero)")
+        growth = rss1 - rss0
+        emit("streaming_rss_growth_mb", growth, "mb",
+             1.0 if growth < 128.0 else 128.0 / growth)
+        if growth >= 128.0:
+            raise SystemExit(
+                f"streaming: RSS grew {growth:.1f} MB across "
+                f"{int(target - first)} folded ticks (gate: < 128)")
+
+        # fold parity: the served (fold-updated) model's top-10 vs a
+        # ground-truth full retrain over the SAME final store state
+        served = server._dep.models[0]
+        ds, prep, algos, _ = engine.make_components(params)
+        full = algos[0].train(ctx, prep.prepare(ctx, ds.read_training(ctx)))
+        overlaps = []
+        for u in range(0, n_users, 7):
+            a, b = served.users.get(f"u{u}"), full.users.get(f"u{u}")
+            if a is None or b is None:
+                continue
+            sa = served.user_factors[a] @ served.item_factors.T
+            sb = full.user_factors[b] @ full.item_factors.T
+            ka = {served.items.keys()[j] for j in np.argsort(-sa)[:10]}
+            kb = {full.items.keys()[j] for j in np.argsort(-sb)[:10]}
+            overlaps.append(len(ka & kb) / 10.0)
+        overlap = float(np.mean(overlaps))
+        emit("streaming_fold_topk_overlap_at_10", overlap, "rate",
+             overlap / 0.5)
+        if overlap < 0.5:
+            raise SystemExit(
+                f"streaming: fold-in top-10 overlap {overlap:.2f} vs "
+                "full retrain (gate: >= 0.5)")
+    finally:
+        if server is not None:
+            server.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def section(fn, *a):
     """Run one bench section with buffered metrics and ONE retry: the
     bench runtime's compile service occasionally drops a connection
@@ -2369,6 +2559,9 @@ def main():
     if "--only-large-catalog" in sys.argv:
         section(bench_serving_large_catalog)
         return
+    if "--only-streaming" in sys.argv:
+        section(bench_streaming_freshness)
+        return
     if "--only-configs" in sys.argv:   # BASELINE configs 2-5 + seqrec
         section(bench_classification)
         section(bench_similarproduct)
@@ -2400,6 +2593,7 @@ def main():
         section(bench_ecommerce_scale)
         section(bench_multichip_serving)
         section(bench_serving_large_catalog)
+        section(bench_streaming_freshness)
         section(bench_pevlog)
     finally:
         # headline LAST (the driver parses the final JSON line) — even

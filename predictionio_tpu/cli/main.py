@@ -130,6 +130,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh-sharded serve plan — item factors "
                         "partitioned row-wise across the device mesh "
                         "with on-device partial top-k + allgather merge")
+    x.add_argument("--refresh-interval", type=float, default=0.0,
+                   help="streaming freshness: seconds between "
+                        "background delta-scan + fold-in + hot-swap "
+                        "ticks (0 = disabled; PIO_REFRESH_INTERVAL_S "
+                        "applies when unset). Replicas of a fleet "
+                        "stagger their ticks automatically")
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
@@ -298,6 +304,7 @@ def main(argv: Optional[list] = None) -> int:
                 access_key=args.accesskey,
                 batch_window_ms=args.batch_window_ms,
                 mesh=args.mesh or "",
+                refresh_interval_s=args.refresh_interval,
                 server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
             if args.join:
                 # standalone replica: serve locally, register with (and

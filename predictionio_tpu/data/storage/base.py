@@ -377,6 +377,16 @@ class Leases(abc.ABC):
 _UNSET = object()  # sentinel distinguishing "no filter" from "filter == None"
 
 
+class DeltaInvalidated(Exception):
+    """A `scan_columns(since=...)` delta cannot be decoded safely — a
+    delete/tombstone, external-id overwrite, journal rewrite, or
+    over-budget delta landed between the two watermarks, or the driver
+    has no delta path at all. Callers MUST fall back to a full scan
+    (the watermark-keyed full `scan_columns`), which remains the ground
+    truth. Deliberately NOT an OSError: the resilience layer must not
+    retry it as a transient storage fault."""
+
+
 def match_properties(e: Event, properties: Dict[str, object]) -> bool:
     """True iff every (name, value) filter pair appears verbatim in the
     event's properties (the ES field-value query role). Uses the
@@ -480,17 +490,33 @@ class EventStore(abc.ABC):
                      target_entity_id: object = _UNSET,
                      properties: Optional[Dict[str, object]] = None,
                      value_spec=None, require_target: bool = True,
-                     workers: Optional[int] = None):
+                     workers: Optional[int] = None,
+                     since: Optional[Dict[str, int]] = None,
+                     upto: Optional[Dict[str, int]] = None):
         """Columnar training scan: `find` filter semantics, but the
         result is an `EventColumns` struct (interned int32 entity ids,
         float32 values per `value_spec`, int64 event times) instead of
         an Event iterator — the zero-object path template DataSources
         feed into `RatingColumns.from_store`/`PairColumns.from_store`.
 
+        `since` is an `ingest_watermark()` snapshot: decode ONLY data
+        appended after it (the streaming delta path), raising
+        `DeltaInvalidated` whenever the delta cannot be produced exactly
+        (deletes, rewrites, unsupported driver — this base
+        implementation always raises, since `find` has no append-order
+        lower bound). `upto` pins the delta's exclusive upper bound to a
+        watermark the caller snapshotted BEFORE the scan, so the result
+        provably corresponds to the `upto` fingerprint even while
+        writers keep appending.
+
         This base implementation adapts `find()` (drivers keep their
         own pushdown); PEVLOG overrides it with a chunk-parallel
         raw-frame decode. `workers` is advisory — a driver without a
         parallel scan ignores it."""
+        if since is not None:
+            raise DeltaInvalidated(
+                f"{type(self).__name__} has no delta scan path")
+        del upto
         from predictionio_tpu.data.storage.columns import columns_from_events
         return columns_from_events(
             self.find(app_id, channel_id, start_time=start_time,

@@ -1125,7 +1125,10 @@ class PevlogEvents(base.EventStore):
                      target_entity_id=base._UNSET,
                      properties=None, value_spec=None,
                      require_target: bool = True,
-                     workers: Optional[int] = None) -> "columns.EventColumns":
+                     workers: Optional[int] = None,
+                     since: Optional[Dict[str, int]] = None,
+                     upto: Optional[Dict[str, int]] = None
+                     ) -> "columns.EventColumns":
         """`find()` semantics, columnar output: identical index pushdown
         and post-filters, but matching frames decode straight into numpy
         columns (no Event/datetime/DataMap per frame) on a chunked
@@ -1135,7 +1138,23 @@ class PevlogEvents(base.EventStore):
         exactly (legacy frames, in-journal tombstones, external ids)
         fall back to the Event replay per segment. Output is invariant
         under worker count and byte-equivalent to
-        `columns_from_events(self.find(...))`."""
+        `columns_from_events(self.find(...))`.
+
+        With `since=<ingest_watermark snapshot>` only the journal bytes
+        appended after that watermark are decoded (the streaming delta
+        path, see `_scan_delta`); `upto` pins the exclusive upper bound
+        to a second watermark the caller snapshotted before calling."""
+        if since is not None:
+            return self._scan_delta(
+                app_id, channel_id, since=since, upto=upto,
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                properties=properties, value_spec=value_spec,
+                require_target=require_target)
+        del upto
         procs = ingest_workers(workers)
         part = self._part_dir(app_id, channel_id)
         start_us = _us(start_time) if start_time is not None else None
@@ -1225,6 +1244,104 @@ class PevlogEvents(base.EventStore):
                     require_target))
             else:
                 blocks.extend(seg_blocks)
+        return columns.merge_blocks(blocks)
+
+    def _scan_delta(self, app_id: int, channel_id: Optional[int], *,
+                    since: Dict[str, int],
+                    upto: Optional[Dict[str, int]],
+                    start_time=None, until_time=None, entity_type=None,
+                    entity_id=None, event_names=None,
+                    target_entity_type=base._UNSET,
+                    target_entity_id=base._UNSET,
+                    properties=None, value_spec=None,
+                    require_target: bool = True
+                    ) -> "columns.EventColumns":
+        """Decode ONLY the journal bytes in (since, upto]: per segment,
+        frames from the `since` byte offset up to the `upto` size go
+        through the exact `scan_chunk` filter/decode path the full scan
+        uses, so delta rows are byte-equivalent to the tail of a full
+        scan. The result is correct ONLY as an append-delta on top of
+        the `since` snapshot, so anything that rewrites history between
+        the watermarks raises `DeltaInvalidated` (callers fall back to
+        the full scan):
+
+          - tombstones.log grew: a delete may kill rows ALREADY FOLDED
+            into the since snapshot;
+          - external_ids.log grew: a caller-supplied id can overwrite an
+            earlier frame (last-wins), which a pure append-delta would
+            double-count;
+          - a segment shrank, vanished, or was unreadable (-1): the
+            journal was rewritten under us;
+          - a delta frame is evlog-legacy / in-journal "$tombstone" /
+            externally-identified ("exact" from `scan_chunk`), or a
+            torn frame truncates the range;
+          - the delta byte span exceeds `PIO_DELTA_MAX_BYTES` (the
+            host-memory bound — a full scan is the better tool then).
+        """
+        part = self._part_dir(app_id, channel_id)
+        wm = upto if upto is not None else self.ingest_watermark(
+            app_id, channel_id)
+        for name in ("tombstones.log", "external_ids.log"):
+            if wm.get(name, 0) != since.get(name, 0):
+                raise base.DeltaInvalidated(
+                    f"{name} changed between watermarks "
+                    f"({since.get(name, 0)} -> {wm.get(name, 0)})")
+        spans: List[Tuple[str, int, int]] = []   # (seg name, lo, hi)
+        for name, lo in since.items():
+            if name in ("tombstones.log", "external_ids.log"):
+                continue
+            hi = wm.get(name)
+            if hi is None or hi < lo or lo < 0 or hi < 0:
+                raise base.DeltaInvalidated(
+                    f"segment {name} rewritten between watermarks "
+                    f"({lo} -> {hi})")
+        for name, hi in wm.items():
+            if name in ("tombstones.log", "external_ids.log"):
+                continue
+            if hi < 0:
+                raise base.DeltaInvalidated(f"segment {name} unreadable")
+            lo = since.get(name, 0)
+            if hi > lo:
+                spans.append((name, lo, hi))
+        budget = int(os.environ.get("PIO_DELTA_MAX_BYTES", "")
+                     or _DELTA_MAX_BYTES)
+        if sum(hi - lo for _, lo, hi in spans) > budget:
+            raise base.DeltaInvalidated(
+                "delta span exceeds PIO_DELTA_MAX_BYTES "
+                f"({sum(h - l for _, l, h in spans)} > {budget})")
+        dead = self._tombstones(part)
+        if len(dead) > _DEAD_SHIP_MAX:
+            raise base.DeltaInvalidated("tombstone map too large for "
+                                        "the raw-frame delta decode")
+        spec = columns.normalize_value_spec(value_spec)
+        start_us = _us(start_time) if start_time is not None else None
+        until_us = _us(until_time) if until_time is not None else None
+        cfg_blob = pickle.dumps(
+            {"start_us": start_us, "until_us": until_us,
+             "entity_type": entity_type, "entity_id": entity_id,
+             "event_names": frozenset(event_names) if event_names else None,
+             "tet": columns.encode_target(target_entity_type, base._UNSET),
+             "tei": columns.encode_target(target_entity_id, base._UNSET),
+             "properties": dict(properties) if properties else None,
+             "value_spec": spec, "require_target": require_target,
+             "dead": dict(dead)},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        blocks: List[tuple] = []
+        for name, lo, hi in spans:
+            seg = part / name
+            # no index pushdown here: the skip-index may not cover the
+            # fresh tail yet, and delta spans are small by construction
+            status, block, consumed = scan_chunk(str(seg), lo, hi,
+                                                 cfg_blob)
+            if status != "ok":
+                raise base.DeltaInvalidated(
+                    f"segment {name} delta needs dict semantics "
+                    "(legacy/tombstone/external-id frame)")
+            if consumed < hi:
+                raise base.DeltaInvalidated(
+                    f"segment {name} torn mid-delta at {consumed}")
+            self.c.stats["segments_scanned"] += 1
+            blocks.append(block)
         return columns.merge_blocks(blocks)
 
     def _event_block(self, table: Dict[str, Event], dead, filters,
@@ -1350,6 +1467,7 @@ class PevlogEvents(base.EventStore):
 
 _CHUNK_MIN_BYTES = 1 << 20      # don't chunk journals under 1 MiB
 _DEAD_SHIP_MAX = 50_000         # tombstone-map size cap for worker cfg
+_DELTA_MAX_BYTES = 64 * 1024 * 1024   # delta host-memory bound default
 _SCAN_POOL = None
 _SCAN_POOL_PROCS = 0            # -1 = pools unusable in this process
 _SCAN_POOL_LOCK = threading.Lock()

@@ -144,6 +144,44 @@ def _cache_path(cache_dir: Path, sig: dict) -> Path:
     return cache_dir / (hashlib.sha256(blob).hexdigest() + ".pioc")
 
 
+# newest-N prepared-data cache entries kept per directory; every
+# distinct (filters, spec) signature is one entry, so a store queried
+# under many specs (multi-template apps, streaming re-scans) would
+# otherwise grow `_prepared/` without bound. `PIO_INGEST_CACHE_MAX`
+# overrides (<= 0 disables eviction).
+_CACHE_MAX = 8
+
+
+def _evict_cache(cache_dir: Path) -> None:
+    """Drop the oldest `.pioc` entries beyond the newest-N retention
+    bound (mtime order; the store refreshes mtime on every hit so the
+    working set survives). Best-effort: a vanished or busy file is
+    someone else's eviction racing ours, never an error."""
+    try:
+        keep = int(os.environ.get("PIO_INGEST_CACHE_MAX", _CACHE_MAX))
+    except ValueError:
+        keep = _CACHE_MAX
+    if keep <= 0:
+        return
+    try:
+        entries = sorted(cache_dir.glob("*.pioc"),
+                         key=lambda p: p.stat().st_mtime, reverse=True)
+    except OSError:
+        return
+    evicted = 0
+    for p in entries[keep:]:
+        try:
+            p.unlink()
+            evicted += 1
+        except OSError:
+            pass
+    if evicted:
+        obs_metrics.get_registry().counter(
+            "pio_ingest_cache_evictions_total",
+            "Prepared-data cache entries evicted by the newest-N "
+            "retention bound").inc(evicted)
+
+
 def _cache_store(path: Path, watermark: Dict[str, int], kind: str,
                  arrays: Dict[str, np.ndarray],
                  tables: Dict[str, List[str]]) -> None:
@@ -188,6 +226,10 @@ def _cache_load(path: Path, watermark: Dict[str, int], kind: str):
                 raise ValueError("truncated column")
             arrays[name] = a
             off = end
+        try:
+            os.utime(path)               # LRU signal for _evict_cache
+        except OSError:
+            pass
         return arrays, header["tables"]
     except (integrity.CorruptBlobError, ValueError, KeyError, TypeError):
         return None
@@ -362,6 +404,7 @@ def _prepared(store, app_id, channel_id, sig, kind, filters, spec,
     _record_stage("build", time.perf_counter() - t0)
     if path is not None:
         _cache_store(path, watermark, kind, arrays, tables)
+        _evict_cache(cache_dir)
     return arrays, tables
 
 

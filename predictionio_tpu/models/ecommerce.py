@@ -259,6 +259,54 @@ class ECommAlgorithm(Algorithm):
             banned_width=width, mesh=mesh)
         return self._serve_plan.warm()
 
+    def fold_in(self, model: ECommModel, delta, fctx) -> ECommModel:
+        """Streaming fold-in: implicit-ALS half-steps over the rows the
+        delta's VIEW events touched, plus a buy-count merge into the
+        popularity fallback. The view re-scan derives the touched sets
+        under this template's own spec — a buy of a never-viewed item
+        is outside the factor model (train builds BiMaps from views)
+        and must not force a full rebuild. Count-merged popularity may
+        over-count events racing a full rebuild; the periodic full
+        retrain remains ground truth."""
+        from predictionio_tpu.streaming.updaters import (
+            fold_als_items, fold_als_users,
+        )
+        p = self.params
+        views = fctx.delta_columns(
+            entity_type="user", event_names=["view"],
+            value_spec={"*": 1.0}, require_target=True)
+        pop = model.popularity.copy()
+        buys = fctx.delta_columns(
+            entity_type="user", event_names=["buy"],
+            value_spec={"*": 1.0}, require_target=True)
+        for tix in buys.target_ix:
+            ix = model.items.get(buys.targets[int(tix)])
+            if ix is not None:
+                pop[ix] += 1.0
+        if views.n == 0:
+            if buys.n == 0:
+                return None
+            return ECommModel(model.user_factors, model.item_factors,
+                              model.users, model.items, pop,
+                              model.item_categories)
+
+        def value_of(ev):
+            return 1.0
+
+        uf, users2, _ = fold_als_users(
+            fctx, model.users, model.items, model.user_factors,
+            model.item_factors, list(views.entities),
+            event_names=["view"], value_of=value_of,
+            dedup_last_wins=False, reg=p.lambda_, implicit=True,
+            alpha=p.alpha)
+        yf, _ = fold_als_items(
+            fctx, users2, model.items, uf, model.item_factors,
+            list(views.targets), event_names=["view"],
+            value_of=value_of, dedup_last_wins=False, reg=p.lambda_,
+            implicit=True, alpha=p.alpha)
+        return ECommModel(uf, yf, users2, model.items, pop,
+                          model.item_categories)
+
     def batch_predict(self, model, queries):
         """Batched serve path. Known-user queries without dense-mask
         needs (no categories/whiteList) coalesce into ONE banned-index

@@ -167,6 +167,43 @@ class ALSAlgorithm(Algorithm):
             banned_width=64, mesh=mesh)
         return self._serve_plan.warm()
 
+    def fold_in(self, model: als.ALSModel, delta, fctx) -> als.ALSModel:
+        """Streaming fold-in: closed-form ALS half-steps over the
+        delta's touched rows only — touched users re-solved against
+        fixed item factors, then touched items against the updated user
+        factors. Untouched rows stay bit-identical; the periodic full
+        retrain remains ground truth (streaming/updaters.py)."""
+        from predictionio_tpu.streaming.updaters import (
+            fold_als_items, fold_als_users,
+        )
+        p = self.params
+        buy_rating = float(fctx.ds_params.get("buy_rating", 4.0))
+        # touched sets under THIS template's event spec — the generic
+        # change scan covers every event type, and a user touched only
+        # by a foreign event has an empty rating history (folding that
+        # would zero a perfectly good row)
+        rated = fctx.delta_columns(
+            entity_type="user", event_names=["rate", "buy"],
+            value_spec={"*": 1.0}, require_target=True)
+        if rated.n == 0:
+            return None
+
+        def value_of(ev):
+            if ev.event == "buy":
+                return buy_rating
+            return ev.properties.get_or_else("rating", None)
+
+        uf, users2, _ = fold_als_users(
+            fctx, model.users, model.items, model.user_factors,
+            model.item_factors, list(rated.entities),
+            event_names=["rate", "buy"], value_of=value_of,
+            dedup_last_wins=True, reg=p.lambda_)
+        yf, _ = fold_als_items(
+            fctx, users2, model.items, uf, model.item_factors,
+            list(rated.targets), event_names=["rate", "buy"],
+            value_of=value_of, dedup_last_wins=True, reg=p.lambda_)
+        return als.ALSModel(uf, yf, users2, model.items)
+
     def batch_predict(self, model: als.ALSModel,
                       queries: Sequence[Tuple[int, Query]]
                       ) -> List[Tuple[int, PredictedResult]]:

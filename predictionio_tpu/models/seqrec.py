@@ -104,6 +104,48 @@ class SeqRecAlgorithm(Algorithm):
             seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
         return SeqRecServingModel(net, pd.users, pd.items)
 
+    def fold_in(self, model: SeqRecServingModel, delta,
+                fctx) -> Optional[SeqRecServingModel]:
+        """Streaming fold-in: ONE warm-start epoch from the previous
+        transformer weights over sequences rebuilt from the full event
+        set (adam restarts fresh — a mini-epoch, not a retrain; the
+        full re-read is the cost ceiling, the delta only gates the
+        run). New ITEMS invalidate — the tied item table's shape is
+        baked into the net. New users are fine: serving reads each
+        user's history at query time, so they never index the net."""
+        from predictionio_tpu.data.storage.base import DeltaInvalidated
+        p = self.params
+        cols = fctx.delta_columns(
+            entity_type="user", event_names=list(p.event_names),
+            value_spec={"*": 1.0}, require_target=True)
+        if cols.n == 0:
+            return None
+        full = fctx.store.scan_columns(
+            fctx.app_id, fctx.channel_id, entity_type="user",
+            event_names=list(p.event_names), value_spec={"*": 1.0},
+            require_target=True)
+        i_of = np.array([model.items.get(t, -1) for t in full.targets],
+                        np.int64)
+        if (i_of < 0).any():
+            raise DeltaInvalidated(
+                "new items since train: the tied item-table shape is "
+                "baked into the net; full rebuild required")
+        seqs, targets = build_sequences(
+            full.entity_ix.astype(np.int64), i_of[full.target_ix],
+            full.t_millis, n_items=model.net.n_items,
+            seq_len=model.net.seq_len)
+        if not len(seqs):
+            return None
+        bsz = min(p.batch_size, len(seqs))
+        net = seqrec_train(
+            seqs, targets, n_items=model.net.n_items,
+            seq_len=model.net.seq_len, dim=p.dim, n_heads=p.n_heads,
+            n_layers=p.n_layers, batch_size=bsz, epochs=1, lr=p.lr,
+            temperature=p.temperature,
+            seed=p.seed if p.seed is not None else 0, mesh=fctx.mesh,
+            init_params=model.net.params)
+        return SeqRecServingModel(net, model.users, model.items)
+
     # -- serving -------------------------------------------------------------
     def _ctx(self) -> RuntimeContext:
         ctx = getattr(self, "_serving_ctx", None)

@@ -114,6 +114,11 @@ class SimilarModel:
     item_factors: np.ndarray
     items: BiMap
     item_categories: Dict[str, List[str]]
+    # user-side factors, kept since the streaming subsystem so fold-in
+    # can run the item half-step against them; None on artifacts
+    # trained before then (those force the full-scan path)
+    user_factors: Optional[np.ndarray] = None
+    users: Optional[BiMap] = None
 
     def sanity_check(self):
         assert np.isfinite(self.item_factors).all()
@@ -176,6 +181,42 @@ class _FactorSimilarityAlgorithm(Algorithm):
             out.append((i, PredictedResult(tuple(items))))
         return out
 
+    def _fold(self, model: SimilarModel, fctx, *, event_names,
+              value_spec, value_of,
+              dedup_last_wins) -> Optional[SimilarModel]:
+        """Shared streaming fold: implicit-ALS half-steps over the rows
+        this algorithm's delta events touched (user rows vs fixed item
+        factors, then item rows vs the updated user factors). Artifacts
+        trained before the streaming subsystem carry no user-side
+        factors and fall back to the full-scan path."""
+        from predictionio_tpu.data.storage.base import DeltaInvalidated
+        from predictionio_tpu.streaming.updaters import (
+            fold_als_items, fold_als_users,
+        )
+        if model.user_factors is None or model.users is None:
+            raise DeltaInvalidated(
+                "artifact predates streaming (no user-side factors); "
+                "full rebuild required")
+        p = self.params
+        cols = fctx.delta_columns(
+            entity_type="user", event_names=list(event_names),
+            value_spec=value_spec, require_target=True)
+        if cols.n == 0:
+            return None
+        uf, users2, _ = fold_als_users(
+            fctx, model.users, model.items, model.user_factors,
+            model.item_factors, list(cols.entities),
+            event_names=event_names, value_of=value_of,
+            dedup_last_wins=dedup_last_wins, reg=p.lambda_,
+            implicit=True, alpha=p.alpha)
+        yf, _ = fold_als_items(
+            fctx, users2, model.items, uf, model.item_factors,
+            list(cols.targets), event_names=event_names,
+            value_of=value_of, dedup_last_wins=dedup_last_wins,
+            reg=p.lambda_, implicit=True, alpha=p.alpha)
+        return SimilarModel(yf, model.items, model.item_categories,
+                            user_factors=uf, users=users2)
+
 
 @dataclass(frozen=True)
 class ALSParams(Params):
@@ -196,11 +237,20 @@ class ALSAlgorithm(_FactorSimilarityAlgorithm):
         if pd.views.n == 0:
             raise ValueError("No view events found "
                              "(ALSAlgorithm.scala require non-empty)")
-        _, y = als.als_train(
+        x, y = als.als_train(
             pd.views, rank=p.rank, iterations=p.num_iterations,
             reg=p.lambda_, implicit=True, alpha=p.alpha,
             seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
-        return SimilarModel(y, pd.views.items, pd.item_categories)
+        return SimilarModel(y, pd.views.items, pd.item_categories,
+                            user_factors=x, users=pd.views.users)
+
+    def fold_in(self, model: SimilarModel, delta,
+                fctx) -> Optional[SimilarModel]:
+        """Streaming fold-in on the delta's view events."""
+        return self._fold(model, fctx, event_names=["view"],
+                          value_spec={"*": 1.0},
+                          value_of=lambda ev: 1.0,
+                          dedup_last_wins=False)
 
 
 class LikeAlgorithm(_FactorSimilarityAlgorithm):
@@ -213,11 +263,22 @@ class LikeAlgorithm(_FactorSimilarityAlgorithm):
         p = self.params
         if pd.likes.n == 0:
             raise ValueError("No like/dislike events found")
-        _, y = als.als_train(
+        x, y = als.als_train(
             pd.likes, rank=p.rank, iterations=p.num_iterations,
             reg=p.lambda_, implicit=True, alpha=p.alpha,
             seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
-        return SimilarModel(y, pd.likes.items, pd.item_categories)
+        return SimilarModel(y, pd.likes.items, pd.item_categories,
+                            user_factors=x, users=pd.likes.users)
+
+    def fold_in(self, model: SimilarModel, delta,
+                fctx) -> Optional[SimilarModel]:
+        """Streaming fold-in on like/dislike events (latest wins,
+        matching the training dedup)."""
+        return self._fold(
+            model, fctx, event_names=["like", "dislike"],
+            value_spec={"like": 1.0, "dislike": -1.0},
+            value_of=lambda ev: 1.0 if ev.event == "like" else -1.0,
+            dedup_last_wins=True)
 
 
 @dataclass(frozen=True)
@@ -248,6 +309,60 @@ class CooccurrenceAlgorithm(Algorithm):
             len(views.users), len(views.items), self.params.n,
             max_items_per_user=self.params.max_items_per_user)
         return CoocModel(top, views.items, pd.item_categories)
+
+    def fold_in(self, model: CoocModel, delta,
+                fctx) -> Optional[CoocModel]:
+        """Streaming count-merge fold: for each delta-touched user, an
+        item is NEWLY connected when its full-history view count equals
+        its delta view count (every view of it by that user is inside
+        the delta), and each new item pairs once with the user's other
+        distinct items — exactly the pairs the reference self-join
+        would gain. Increments merge into the stored top-N lists via
+        `ops.cooccur.merge_pair_counts` (its docstring states the
+        truncation approximation; full retrain is ground truth)."""
+        from predictionio_tpu.data.storage.base import DeltaInvalidated
+        from predictionio_tpu.ops.cooccur import merge_pair_counts
+        cols = fctx.delta_columns(
+            entity_type="user", event_names=["view"],
+            value_spec={"*": 1.0}, require_target=True)
+        if cols.n == 0:
+            return None
+        delta_cnt: Dict[str, Dict[str, int]] = {}
+        for eix, tix in zip(cols.entity_ix, cols.target_ix):
+            u = cols.entities[int(eix)]
+            it = cols.targets[int(tix)]
+            d = delta_cnt.setdefault(u, {})
+            d[it] = d.get(it, 0) + 1
+        pairs: Dict[Tuple[int, int], float] = {}
+        for u, dcnt in delta_cnt.items():
+            full: Dict[int, int] = {}
+            for ev in fctx.user_history(u, ["view"]):
+                ix = model.items.get(ev.target_entity_id)
+                if ix is None:
+                    raise DeltaInvalidated(
+                        f"user {u!r} viewed unknown item "
+                        f"{ev.target_entity_id!r}; full rebuild "
+                        "required")
+                full[ix] = full.get(ix, 0) + 1
+            new: List[int] = []
+            for it, c in dcnt.items():
+                ix = model.items.get(it)
+                if ix is None:
+                    raise DeltaInvalidated(
+                        f"new item {it!r} in delta; full rebuild "
+                        "required")
+                if full.get(ix, 0) == c:
+                    new.append(ix)
+            new_set = set(new)
+            old = [ix for ix in full if ix not in new_set]
+            for ai, a in enumerate(new):
+                for b in old + new[ai + 1:]:
+                    key = (a, b) if a < b else (b, a)
+                    pairs[key] = pairs.get(key, 0.0) + 1.0
+        if not pairs:
+            return None
+        return CoocModel(merge_pair_counts(model.top, pairs),
+                         model.items, model.item_categories)
 
     def predict(self, model: CoocModel, query: Query) -> PredictedResult:
         n_items = len(model.items)

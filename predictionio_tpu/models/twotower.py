@@ -83,6 +83,50 @@ class TwoTowerAlgorithm(Algorithm):
             seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
         return TwoTowerServingModel(net, pd.users, pd.items)
 
+    def fold_in(self, model: TwoTowerServingModel, delta,
+                fctx) -> Optional[TwoTowerServingModel]:
+        """Streaming fold-in: ONE warm-start epoch from the previous
+        tower weights over the full interaction set (adam restarts
+        fresh, so converged weights move only slightly — a mini-epoch,
+        not a retrain). The full re-read is this hook's cost ceiling;
+        the delta only gates whether it runs. New users or items change
+        the embedding-table shapes and invalidate the delta; artifacts
+        without raw weights (pre-streaming) do the same."""
+        from predictionio_tpu.data.storage.base import DeltaInvalidated
+        p = self.params
+        ev_names = list(fctx.ds_params.get(
+            "event_names", ("view", "rate", "buy")))
+        cols = fctx.delta_columns(
+            entity_type="user", event_names=ev_names,
+            value_spec={"*": 1.0}, require_target=True)
+        if cols.n == 0:
+            return None
+        if model.net.params is None:
+            raise DeltaInvalidated(
+                "artifact predates streaming (no raw tower weights); "
+                "full rebuild required")
+        full = fctx.store.scan_columns(
+            fctx.app_id, fctx.channel_id, entity_type="user",
+            event_names=ev_names, value_spec={"*": 1.0},
+            require_target=True)
+        u_of = np.array([model.users.get(e, -1) for e in full.entities],
+                        np.int64)
+        i_of = np.array([model.items.get(t, -1) for t in full.targets],
+                        np.int64)
+        if (u_of < 0).any() or (i_of < 0).any():
+            raise DeltaInvalidated(
+                "new users/items since train: embedding-table shapes "
+                "are baked into the net; full rebuild required")
+        net = twotower_train(
+            u_of[full.entity_ix], i_of[full.target_ix],
+            n_users=len(model.users), n_items=len(model.items),
+            emb_dim=p.emb_dim, hidden=p.hidden, out_dim=p.out_dim,
+            batch_size=p.batch_size, epochs=1, lr=p.lr,
+            temperature=p.temperature,
+            seed=p.seed if p.seed is not None else 0,
+            mesh=fctx.mesh, init_params=model.net.params)
+        return TwoTowerServingModel(net, model.users, model.items)
+
     def predict(self, model: TwoTowerServingModel,
                 query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]

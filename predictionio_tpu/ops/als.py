@@ -1006,3 +1006,59 @@ class ALSModel:
         assert self.user_factors.ndim == 2 and self.item_factors.ndim == 2
         assert np.isfinite(self.user_factors).all(), "non-finite user factors"
         assert np.isfinite(self.item_factors).all(), "non-finite item factors"
+
+
+# -- streaming fold-in --------------------------------------------------------
+
+# per-row event cap for fold-in (newest kept) — bounds the padded slab
+_FOLD_HISTORY_CAP = 8192
+
+
+def fold_in_rows(opposite: np.ndarray, histories, *, reg: float,
+                 implicit: bool = False, alpha: float = 1.0) -> np.ndarray:
+    """Closed-form least-squares fold-in: re-solve factor rows against
+    FIXED opposite-side factors — one exact ALS half-step, the classic
+    trick for projecting new/updated users into a trained space without
+    a retrain. `histories` is a sequence of `(opposite_ix, value)`
+    array pairs, one per row to solve; returns `[len(histories), rank]`
+    f32 rows.
+
+    Exactness: this drives the same `_solve_bucket` program the
+    reference training sweep runs, with identical reg/alpha semantics
+    (ALS-WR row-count scaling, implicit confidence c = 1 + alpha*|r|),
+    so a folded row equals that row's training solve given the same
+    opposite factors. Shapes are padded to pow2 buckets so repeated
+    refresh ticks hit the jit cache instead of recompiling per tick;
+    histories longer than `_FOLD_HISTORY_CAP` keep their newest events
+    (a documented approximation — such users converge on the next full
+    retrain)."""
+    import jax.numpy as jnp
+
+    opp = np.ascontiguousarray(opposite, np.float32)
+    rank = opp.shape[1]
+    n_rows = len(histories)
+    if n_rows == 0:
+        return np.zeros((0, rank), np.float32)
+    cap = 8
+    for ix, _ in histories:
+        cap = max(cap, min(len(ix), _FOLD_HISTORY_CAP))
+    cap = 1 << (cap - 1).bit_length()
+    b_pad = 1 << (max(8, n_rows) - 1).bit_length()
+    idx = np.full((b_pad, cap), -1, np.int32)
+    val = np.zeros((b_pad, cap), np.float32)
+    for r, (ix, v) in enumerate(histories):
+        ix = np.asarray(ix, np.int32)[-cap:]
+        v = np.asarray(v, np.float32)[-cap:]
+        idx[r, :len(ix)] = ix
+        val[r, :len(v)] = v
+    # YtY only feeds the implicit branch; the explicit trace still
+    # wants the operand, so ship zeros there
+    yty = opp.T @ opp if implicit else np.zeros((rank, rank), np.float32)
+    sol = _solve_bucket(jnp.asarray(opp), jnp.asarray(idx),
+                        jnp.asarray(val), jnp.float32(reg),
+                        jnp.float32(alpha), jnp.asarray(yty),
+                        implicit=implicit)
+    # slice on HOST: an on-device sol[:n_rows] bakes n_rows into a
+    # dynamic_slice program, recompiling for every novel touched-row
+    # count — exactly the per-tick churn the pow2 padding exists to avoid
+    return np.asarray(sol)[:n_rows]

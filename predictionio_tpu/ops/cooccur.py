@@ -34,7 +34,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +79,49 @@ class CooccurrenceModel:
 
     def sanity_check(self):
         assert self.top_items.shape == self.top_counts.shape
+
+
+def merge_pair_counts(model: CooccurrenceModel,
+                      pair_updates: Dict[Tuple[int, int], float]
+                      ) -> CooccurrenceModel:
+    """Fold symmetric pair-count increments into the stored top-N lists
+    (the streaming count-merge fold for this model).
+
+    Each ``(i, j) -> c`` update bumps j in i's row and i in j's row. A
+    partner not currently in a row's top-N enters with count == the
+    increment alone: its true historical count is unknown once the row
+    was truncated to top-N, so merged counts are a LOWER bound for new
+    entrants. That is the documented approximation of count-merge
+    fold-in — the periodic full retrain is ground truth. Rows touched
+    by no update are returned untouched (same array rows, bit-equal).
+    """
+    top_items = model.top_items.copy()
+    top_counts = model.top_counts.copy()
+    n_items, k = top_items.shape
+    per_row: Dict[int, Dict[int, float]] = {}
+    for (i, j), inc in pair_updates.items():
+        if i == j:
+            continue
+        for row, col in ((int(i), int(j)), (int(j), int(i))):
+            if row >= n_items or col >= n_items:
+                raise ValueError(
+                    f"pair ({row}, {col}) outside catalog of {n_items} "
+                    "items — new items need a full rebuild")
+            d = per_row.setdefault(row, {})
+            d[col] = d.get(col, 0.0) + float(inc)
+    for row, deltas in per_row.items():
+        counts = {int(it): float(c)
+                  for it, c in zip(top_items[row], top_counts[row])
+                  if c > 0}
+        for col, inc in deltas.items():
+            counts[col] = counts.get(col, 0.0) + inc
+        ranked = sorted(counts.items(), key=lambda kv: -kv[1])[:k]
+        top_items[row] = 0
+        top_counts[row] = 0.0
+        for s, (it, c) in enumerate(ranked):
+            top_items[row, s] = it
+            top_counts[row, s] = c
+    return CooccurrenceModel(top_items, top_counts)
 
 
 def top_cooccurrences(cooccur: np.ndarray, n: int) -> CooccurrenceModel:

@@ -140,16 +140,22 @@ def seqrec_train(sequences: np.ndarray, targets: np.ndarray, *,
                  n_heads: int = 2, n_layers: int = 2,
                  batch_size: int = 256, epochs: int = 5,
                  lr: float = 3e-3, temperature: float = 0.07,
-                 seed: int = 0, mesh=None) -> SeqRecModel:
+                 seed: int = 0, mesh=None,
+                 init_params=None) -> SeqRecModel:
     """Train on [N, seq_len] right-aligned item-id sequences (PAD =
     n_items) with [N] next-item targets. `mesh` shards the batch over
     "data" and — when the mesh has an "sp" axis — the sequence over it
-    via ring attention."""
+    via ring attention. `init_params` resumes from a prior model's
+    weights (the streaming warm-start mini-epoch); optimizer state
+    starts fresh."""
     import optax
 
     assert sequences.shape[1] == seq_len
-    params = _init_params(jax.random.PRNGKey(seed), n_items, seq_len,
-                          dim, n_layers)
+    if init_params is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, init_params)
+    else:
+        params = _init_params(jax.random.PRNGKey(seed), n_items,
+                              seq_len, dim, n_layers)
     opt = optax.adam(lr)
     opt_state = opt.init(params)
     n = (len(sequences) // batch_size) * batch_size

@@ -534,6 +534,24 @@ class BucketedTopK:
             compiled += 1
         return compiled
 
+    def swap_factors(self, item_factors) -> np.ndarray:
+        """Hot-swap the resident factor block (the streaming refresher's
+        commit). The bucket executables take the factor operand
+        POSITIONALLY per call, so a same-shape/dtype replacement reuses
+        every AOT executable — only the new block crosses host->device,
+        zero recompiles. Returns the PREVIOUS host factors (the
+        rollback token). Shape changes must re-warm instead."""
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        if host.shape != (self.n_items, self.rank):
+            raise ValueError(
+                f"swap_factors shape {host.shape} != "
+                f"{(self.n_items, self.rank)}: catalog changed — a hot "
+                "swap cannot resize the AOT plan; re-warm instead")
+        prev = self._host_factors
+        self._host_factors = host
+        self.factors = device_resident(host)
+        return prev
+
     @property
     def max_bucket(self) -> int:
         return self.buckets[-1]
@@ -621,6 +639,22 @@ class BucketedSimilar:
                                     k=self.k).compile()
             compiled += 1
         return compiled
+
+    def swap_factors(self, item_factors) -> np.ndarray:
+        """Hot-swap the resident factor block without recompiling (the
+        executables take the factors positionally); returns the
+        previous host factors as the rollback token. See
+        `BucketedTopK.swap_factors`."""
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        if host.shape != (self.n_items, self.rank):
+            raise ValueError(
+                f"swap_factors shape {host.shape} != "
+                f"{(self.n_items, self.rank)}: catalog changed — a hot "
+                "swap cannot resize the AOT plan; re-warm instead")
+        prev = self._host_factors
+        self._host_factors = host
+        self.factors = device_resident(host)
+        return prev
 
     @property
     def max_bucket(self) -> int:

@@ -200,6 +200,7 @@ class _ShardedPlanBase:
         self.n_shards = int(mesh.shape[SHARD_AXIS])  # lint: ok — host
         # row-shard the (zero-padded) factors across the mesh ONCE; the
         # sharded array is the plan's resident model state
+        self._host_factors = host
         self.factors, _ = shard_put(host, mesh, SHARD_AXIS)
         self.n_pad = int(self.factors.shape[0])  # lint: ok — shape meta
         self.per_shard = self.n_pad // self.n_shards
@@ -209,6 +210,24 @@ class _ShardedPlanBase:
         self.k_shard = min(self.k, self.per_shard)
         self._exe: dict = {}
         _publish_shard_gauges(self.n_shards, self.per_shard, self.rank)
+
+    def swap_factors(self, item_factors) -> np.ndarray:
+        """Hot-swap the sharded resident factors (streaming refresher
+        commit): same shape => same mesh/axis sharding => the per-bucket
+        executables (which take the factor operand positionally) keep
+        serving with zero recompiles; only the new rows cross to the
+        devices. Returns the previous host factors (rollback token)."""
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)
+        if host.shape != (self.n_items, self.rank):
+            raise ValueError(
+                f"swap_factors shape {host.shape} != "
+                f"{(self.n_items, self.rank)}: catalog changed — a hot "
+                "swap cannot resize the AOT plan; re-warm instead")
+        factors, _ = shard_put(host, self.mesh, SHARD_AXIS)
+        prev = self._host_factors
+        self._host_factors = host
+        self.factors = factors
+        return prev
 
     @property
     def max_bucket(self) -> int:

@@ -15,6 +15,7 @@ as every other recommender.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +26,11 @@ import numpy as np
 class TwoTowerModel:
     user_emb: np.ndarray    # [n_users, dim] final tower outputs
     item_emb: np.ndarray    # [n_items, dim]
+    # raw tower weights, kept so streaming fold-in can run a warm-start
+    # mini-epoch from the converged state (None on artifacts trained
+    # before the streaming subsystem existed — those fall back to a
+    # full rebuild)
+    params: Optional[dict] = None
 
     def sanity_check(self):
         assert np.isfinite(self.user_emb).all()
@@ -75,8 +81,14 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
                    emb_dim: int = 32, hidden: int = 64, out_dim: int = 32,
                    batch_size: int = 1024, epochs: int = 10,
                    lr: float = 1e-2, temperature: float = 0.1,
-                   seed: int = 0, mesh=None) -> TwoTowerModel:
-    """Train on interaction pairs; returns materialized tower embeddings."""
+                   seed: int = 0, mesh=None,
+                   init_params: Optional[dict] = None) -> TwoTowerModel:
+    """Train on interaction pairs; returns materialized tower embeddings.
+
+    `init_params` resumes from a prior model's weights (the streaming
+    warm-start mini-epoch); optimizer state starts fresh, so a single
+    epoch from converged weights moves them only slightly.
+    """
     import optax
 
     n = len(u_ix)
@@ -84,7 +96,11 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
         raise ValueError("no interaction pairs")
     batch_size = min(batch_size, n)
     key = jax.random.PRNGKey(seed)
-    params = _init_params(key, n_users, n_items, emb_dim, hidden, out_dim)
+    if init_params is not None:
+        params = {k: jnp.asarray(v) for k, v in init_params.items()}
+    else:
+        params = _init_params(key, n_users, n_items, emb_dim, hidden,
+                              out_dim)
     if mesh is not None and "model" in mesh.axis_names:
         # tensor parallelism: embedding tables row-sharded over "model"
         # (vocab dim), tower MLPs Megatron-style (w1 col-, w2 row-sharded);
@@ -163,4 +179,6 @@ def twotower_train(u_ix: np.ndarray, i_ix: np.ndarray, *,
                      params["user_w2"], jnp.arange(n_users))
     item_emb = tower(params["item_table"], params["item_w1"],
                      params["item_w2"], jnp.arange(n_items))
-    return TwoTowerModel(np.asarray(user_emb), np.asarray(item_emb))
+    return TwoTowerModel(np.asarray(user_emb), np.asarray(item_emb),
+                         params={k: np.asarray(v)
+                                 for k, v in params.items()})

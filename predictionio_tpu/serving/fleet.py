@@ -254,18 +254,27 @@ class FleetServer(HTTPServerBase):
         self._routes()
 
     # -- lifecycle ----------------------------------------------------------
-    def _replica_config(self) -> ServerConfig:
+    def _replica_config(self, index: int = 0) -> ServerConfig:
         """Replicas bind loopback ephemeral ports, skip the per-process
         fsck sweep, and never probe/undeploy a port occupant (the fleet
-        owns the public port; replica ports are fresh)."""
+        owns the public port; replica ports are fresh). Streaming
+        refreshers get a per-replica stagger — replica i's first tick
+        lands i/replicas of the way through the interval — so at most
+        one replica of the fleet is folding at any instant and a
+        poisoned swap (rolled back) never hits every replica at once
+        (the rolling variant of the serve-path hot swap)."""
+        stagger = 0.0
+        if self.config.refresh_interval_s > 0 and self.fleet.replicas > 1:
+            stagger = (index * self.config.refresh_interval_s
+                       / self.fleet.replicas)
         return dataclasses.replace(
             self.config, ip="127.0.0.1", port=0, startup_check=False,
-            max_inflight=0)
+            max_inflight=0, refresh_stagger_s=stagger)
 
     def start(self, background: bool = True) -> int:
         for i in range(self.fleet.replicas):
             server = PredictionServer(
-                self._replica_config(), registry=self.ctx.registry,
+                self._replica_config(i), registry=self.ctx.registry,
                 plugins=self._plugins, engine=self._engine_arg,
                 metrics=self.metrics)
             rep = _Replica(i, server)

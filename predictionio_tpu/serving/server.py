@@ -167,6 +167,15 @@ class ServerConfig:
     # Empty = auto: shard only when the trained instance recorded a mesh
     # or the catalog exceeds one device's capacity
     mesh: str = ""
+    # streaming freshness: > 0 starts a background Refresher thread that
+    # delta-scans the journal tail every this-many seconds and fold-swaps
+    # updated factors into the live serve plans (0 = disabled; the
+    # PIO_REFRESH_INTERVAL_S env knob applies when this is 0)
+    refresh_interval_s: float = 0.0
+    # fleet rolling variant: delay before the refresher's first tick,
+    # set per replica by FleetServer so at most one replica of a fleet
+    # is folding at any instant
+    refresh_stagger_s: float = 0.0
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -523,6 +532,23 @@ class PredictionServer(HTTPServerBase):
         self._restore_dispatch_state()
         self._load(instance)
         self._routes()
+        # streaming freshness: the config interval wins; otherwise the
+        # PIO_REFRESH_INTERVAL_S env knob applies (0/absent = disabled)
+        self._refresher = None
+        interval = config.refresh_interval_s
+        if interval <= 0:
+            import os
+            try:
+                interval = float(  # lint: ok (env string, host value)
+                    os.environ.get("PIO_REFRESH_INTERVAL_S", "0") or 0)
+            except ValueError:
+                interval = 0.0
+        if interval > 0:
+            from predictionio_tpu.streaming import Refresher
+            self._refresher = Refresher(
+                self, interval, stagger_s=config.refresh_stagger_s,
+                metrics=self.metrics)
+            self._refresher.start()
 
     # -- deployment lifecycle ----------------------------------------------
     def _resolve_instance(self):
@@ -562,6 +588,17 @@ class PredictionServer(HTTPServerBase):
         # checkpoint the learned dispatch EWMAs on every successful
         # (re)load, so the NEXT process start resumes warm
         self._save_dispatch_state()
+
+    def _refresh_deployment(self, dep: _Deployment,
+                            new_models: Sequence[Any]) -> _Deployment:
+        """A streaming fold's publish step: same engine/instance/
+        algos/serving, fresh models. The caller (streaming.Refresher)
+        swaps the device factors first, then installs this under
+        `_dep_lock` — both model sets score identically mid-swap, so
+        in-flight requests never see a torn deployment."""
+        return _Deployment(dep.engine, dep.instance, dep.algos,
+                           list(new_models), dep.serving,
+                           obs=self._serve_obs)
 
     # -- dispatch-policy persistence ----------------------------------------
     @staticmethod
@@ -669,6 +706,8 @@ class PredictionServer(HTTPServerBase):
             if self._stopping:
                 return
             self._stopping = True
+        if self._refresher is not None:
+            self._refresher.stop()
         budget = max(self.config.drain_timeout_ms / 1000.0, 0.1)
         t0 = time.perf_counter()
         if self._batcher is not None:

@@ -1,0 +1,30 @@
+"""Streaming freshness: the layer between train and serve.
+
+The reference system (PAPER.md) is a Lambda architecture — models go
+stale between full `pio train` runs. This package closes the gap: the
+pevlog journal + ingest watermark already know exactly *what changed*
+since the last snapshot, so a deployed model can stay minutes-fresh
+under a live event firehose without a retrain in the loop.
+
+Three pieces:
+  - `delta` — a generic change summary between two watermark snapshots,
+    built on `EventStore.scan_columns(since=..., upto=...)` (bytes-
+    bounded; raises `DeltaInvalidated` whenever a delete, journal
+    rewrite, or over-budget span makes incremental decode unsafe).
+  - `updaters` — `FoldContext` plus the shared closed-form ALS fold-in
+    helpers the model templates' `fold_in` hooks build on.
+  - `refresher` — the background thread in `PredictionServer` that
+    ticks every `PIO_REFRESH_INTERVAL_S`: delta-scan -> fold-in ->
+    hot-swap the updated factors into the device-resident serve plans
+    (same shapes => the AOT executables keep serving, zero recompiles),
+    with rollback-on-failure through the `streaming.refresh.swap` seam.
+
+The periodic FULL retrain remains ground truth: fold-ins are in-memory
+only and never persisted to the model store.
+"""
+
+from predictionio_tpu.streaming.delta import (  # noqa: F401
+    Delta, scan_delta,
+)
+from predictionio_tpu.streaming.refresher import Refresher  # noqa: F401
+from predictionio_tpu.streaming.updaters import FoldContext  # noqa: F401

@@ -1,0 +1,172 @@
+"""Incremental model updaters: the shared fold-in machinery.
+
+The model templates own their data semantics (what counts as a rating,
+which events matter), so each template exposes a `fold_in(model, delta,
+fctx)` hook; this module supplies what those hooks share — the
+`FoldContext` (store access scoped to the delta window) and the
+closed-form ALS fold helpers.
+
+Fold-in semantics (the idempotence contract): a touched entity's FULL
+history is refetched from the event store and its factor row re-solved
+from scratch against fixed opposite-side factors (one exact ALS
+half-step via `ops.als.fold_in_rows`). Re-applying the same delta is
+therefore a no-op, and untouched rows are bit-identical by
+construction. New USERS extend the BiMap (old indices stable — the
+user side is not baked into any serve plan); new ITEMS invalidate the
+delta, because the item-factor shape IS baked into the AOT serve
+plans and a full rebuild is the correct response.
+
+The periodic full retrain remains ground truth: folded models are
+in-memory only and never persisted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.storage.base import DeltaInvalidated
+from predictionio_tpu.ingest.bimap import BiMap
+from predictionio_tpu.ops import als
+
+
+@dataclass
+class FoldContext:
+    """Store access scoped to one refresh tick's delta window."""
+    store: object                      # events DAO (registry.get_events())
+    app_id: int
+    channel_id: Optional[int]
+    since: Dict[str, int]
+    upto: Dict[str, int]
+    mesh: object = None
+    ds_params: Dict[str, object] = field(default_factory=dict)
+
+    def delta_columns(self, **kw):
+        """Template-spec re-scan of the SAME delta frames the generic
+        change scan decoded (bytes-bounded by the storage contract)."""
+        return self.store.scan_columns(
+            self.app_id, self.channel_id, since=self.since,
+            upto=self.upto, **kw)
+
+    def user_history(self, user_id: str, event_names: Sequence[str]):
+        """A touched user's full interaction history (the serving-time
+        read idiom, LEventStore.findByEntity)."""
+        return self.store.find(
+            self.app_id, self.channel_id, entity_type="user",
+            entity_id=user_id, event_names=list(event_names))
+
+    def item_history(self, item_id: str, event_names: Sequence[str]):
+        """All interactions TARGETING one item (reverse read for the
+        item-side half-step)."""
+        return self.store.find(
+            self.app_id, self.channel_id, entity_type="user",
+            target_entity_id=item_id, event_names=list(event_names))
+
+
+def extend_bimap(base: BiMap, new_keys: Sequence[str]) -> BiMap:
+    """Stable extension: existing ids unchanged, unseen keys appended
+    in first-seen order."""
+    fresh, seen = [], set()
+    for k in new_keys:
+        if base.get(k) is None and k not in seen:
+            fresh.append(k)
+            seen.add(k)
+    if not fresh:
+        return base
+    return BiMap.from_keys(base.keys() + fresh)
+
+
+def _history_arrays(events, key_of: Callable, value_of: Callable,
+                    dedup_last_wins: bool):
+    """(index, value) arrays from an event iterator. `key_of` maps an
+    event to an opposite-side dense index (None = skip row, raise
+    handled by caller), `value_of` to a float (None = skip)."""
+    rows: List[Tuple[object, int, float]] = []
+    for ev in events:
+        v = value_of(ev)
+        if v is None:
+            continue
+        ix = key_of(ev)
+        rows.append((ev.event_time, ix, float(v)))
+    if dedup_last_wins:
+        last: Dict[int, float] = {}
+        for _, ix, v in sorted(rows, key=lambda r: r[0]):
+            last[ix] = v
+        items = list(last.items())
+        return (np.array([i for i, _ in items], np.int32),
+                np.array([v for _, v in items], np.float32))
+    return (np.array([ix for _, ix, _ in rows], np.int32),
+            np.array([v for _, _, v in rows], np.float32))
+
+
+def fold_als_users(fctx: FoldContext, users: BiMap, items: BiMap,
+                   user_factors: np.ndarray, item_factors: np.ndarray,
+                   touched: Sequence[str], *, event_names: Sequence[str],
+                   value_of: Callable, dedup_last_wins: bool, reg: float,
+                   implicit: bool = False, alpha: float = 1.0):
+    """Re-solve the touched users' rows against FIXED item factors.
+    Returns (new_user_factors, new_users_bimap, n_folded). New users
+    are appended; a history touching an unknown item raises
+    `DeltaInvalidated` (item shapes are baked into the serve plans)."""
+    users2 = extend_bimap(users, touched)
+    histories, rows = [], []
+    for uid in touched:
+        def item_ix(ev, _uid=uid):
+            ii = items.get(ev.target_entity_id)
+            if ii is None:
+                raise DeltaInvalidated(
+                    f"user {_uid!r} touched unknown item "
+                    f"{ev.target_entity_id!r}: item shapes are baked "
+                    "into the AOT serve plans")
+            return ii
+        ix, val = _history_arrays(
+            fctx.user_history(uid, event_names), item_ix, value_of,
+            dedup_last_wins)
+        histories.append((ix, val))
+        rows.append(users2.get(uid))
+    new_rows = als.fold_in_rows(item_factors, histories, reg=reg,
+                                implicit=implicit, alpha=alpha)
+    uf = np.zeros((len(users2), user_factors.shape[1]), np.float32)
+    uf[:len(user_factors)] = user_factors   # untouched rows bit-identical
+    for r, row_ix in enumerate(rows):
+        uf[row_ix] = new_rows[r]
+    return uf, users2, len(rows)
+
+
+def fold_als_items(fctx: FoldContext, users2: BiMap, items: BiMap,
+                   user_factors: np.ndarray, item_factors: np.ndarray,
+                   touched: Sequence[str], *, event_names: Sequence[str],
+                   value_of: Callable, dedup_last_wins: bool, reg: float,
+                   implicit: bool = False, alpha: float = 1.0):
+    """Re-solve the touched items' rows against the (already folded)
+    user factors — the second half of the fold sweep, and the part
+    that actually flows into the device-resident serve plans. Returns
+    (new_item_factors, n_folded). Unknown items or unknown users
+    raise `DeltaInvalidated`."""
+    histories, rows = [], []
+    for iid in touched:
+        ii = items.get(iid)
+        if ii is None:
+            raise DeltaInvalidated(
+                f"new item {iid!r} in delta: item shapes are baked "
+                "into the AOT serve plans; full rebuild required")
+        def user_ix(ev, _iid=iid):
+            ui = users2.get(ev.entity_id)
+            if ui is None:
+                raise DeltaInvalidated(
+                    f"item {_iid!r} touched by unknown user "
+                    f"{ev.entity_id!r}")
+            return ui
+        ix, val = _history_arrays(
+            fctx.item_history(iid, event_names), user_ix, value_of,
+            dedup_last_wins)
+        histories.append((ix, val))
+        rows.append(ii)
+    new_rows = als.fold_in_rows(user_factors, histories, reg=reg,
+                                implicit=implicit, alpha=alpha)
+    yf = np.ascontiguousarray(item_factors, np.float32).copy()
+    for r, row_ix in enumerate(rows):
+        yf[row_ix] = new_rows[r]
+    return yf, len(rows)

@@ -37,6 +37,11 @@ Checks, per source file:
     array each is an implicit device->host transfer that blocks the
     accelerator mid-pipeline; read back once per dispatch with
     ``jax.device_get`` (known-host inputs: ``# lint: ok``)
+  - streaming hot loops (streaming/) must not ``.append``/``.extend``
+    into module-level state — the refresher ticks forever, so any
+    per-tick accumulation into process-lifetime state is an unbounded
+    memory leak; keep per-tick state tick-local, or mark a genuinely
+    bounded accumulator ``# lint: ok``
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -78,6 +83,10 @@ _DEVICE_HOT_PATHS = ("predictionio_tpu/ops/topk.py",
 
 # template data sources: training reads must use the columnar scan
 _MODELS_DIRS = ("predictionio_tpu/models/",)
+
+# streaming hot loops: the refresher ticks for the process lifetime, so
+# accumulating into module-level state grows without bound
+_STREAMING_DIRS = ("predictionio_tpu/streaming/",)
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -385,6 +394,47 @@ def _check_training_reads(tree: ast.AST, text: str,
                    "pair_columns (or mark '# lint: ok')")
 
 
+def _check_streaming_accumulation(tree: ast.AST, text: str,
+                                  rel: str) -> Iterator[str]:
+    """In streaming/: forbid ``.append(``/``.extend(`` on a name bound
+    at module scope. The Refresher ticks every PIO_REFRESH_INTERVAL_S
+    for the life of the server process, so any per-tick push into
+    process-lifetime state is an unbounded memory leak that only shows
+    up days into a deploy. Per-tick lists are fine (they die with the
+    tick); a genuinely bounded module-level accumulator (ring buffer,
+    capped dedup set) is marked ``# lint: ok`` on the line."""
+    if not rel.startswith(_STREAMING_DIRS):
+        return
+    module_names = set()
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    module_names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            module_names.add(node.target.id)
+    if not module_names:
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in ("append", "extend")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in module_names):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        yield (f"{rel}:{node.lineno}: .{fn.attr}() into module-level "
+               f"'{fn.value.id}' in a streaming hot loop accumulates "
+               "without bound across refresh ticks; keep per-tick state "
+               "tick-local, or mark a bounded accumulator '# lint: ok'")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -407,6 +457,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_storage_writes(tree, text, rel))
     out.extend(_check_device_transfers(tree, text, rel))
     out.extend(_check_training_reads(tree, text, rel))
+    out.extend(_check_streaming_accumulation(tree, text, rel))
     return out
 
 

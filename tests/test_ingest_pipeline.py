@@ -250,3 +250,58 @@ class TestPreparedCache:
         self._read(store)
         assert list(alt.glob("*.pioc"))
         assert not list(store.ingest_cache_dir(1).glob("*.pioc"))
+
+
+class TestCacheEviction:
+    """Satellite: `_prepared/` retains only the newest-N entries
+    (`PIO_INGEST_CACHE_MAX`), mtime-ordered so the working set — which
+    `_cache_load` touches on every hit — survives eviction."""
+
+    def _read(self, store, **kw):
+        return rating_columns_from_store(
+            store, 1, value_spec=VALUE_SPEC, dedup_last_wins=True, **kw)
+
+    SPECS = ({}, {"event_names": ["rate"]}, {"event_names": ["view"]},
+             {"event_names": ["buy"]})
+
+    def test_newest_n_retained_and_counted(self, store, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_CACHE_MAX", "2")
+        _seed(store)
+        reg = obs_metrics.get_registry()
+        ev0 = reg.value("pio_ingest_cache_evictions_total") or 0.0
+        for kw in self.SPECS:
+            self._read(store, **kw)
+        blobs = list(store.ingest_cache_dir(1).glob("*.pioc"))
+        assert len(blobs) == 2
+        # four distinct signatures, bound of two: two entries evicted
+        assert (reg.value("pio_ingest_cache_evictions_total") or 0.0) \
+            == ev0 + 2
+
+    def test_hit_refreshes_mtime_so_working_set_survives(
+            self, store, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_CACHE_MAX", "3")
+        _seed(store)
+        cache = store.ingest_cache_dir(1)
+        self._read(store)                      # entry A (oldest write)
+        a_path = next(iter(cache.glob("*.pioc")))
+        self._read(store, **self.SPECS[1])     # entry B
+        b_path = next(p for p in cache.glob("*.pioc") if p != a_path)
+        self._read(store, **self.SPECS[2])     # entry C
+        take_phase_timings()
+        self._read(store)                      # hit on A: mtime refreshed
+        assert take_phase_timings().get("ingest_cache_hits") == 1
+        self._read(store, **self.SPECS[3])     # entry D triggers eviction
+        survivors = set(cache.glob("*.pioc"))
+        assert len(survivors) == 3
+        assert a_path in survivors             # touched: kept
+        assert b_path not in survivors         # untouched oldest: evicted
+        take_phase_timings()
+        self._read(store)                      # A still serves hits
+        assert take_phase_timings().get("ingest_cache_hits") == 1
+
+    def test_nonpositive_max_disables_eviction(self, store, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_CACHE_MAX", "0")
+        _seed(store)
+        for kw in self.SPECS:
+            self._read(store, **kw)
+        assert len(list(store.ingest_cache_dir(1).glob("*.pioc"))) == 4

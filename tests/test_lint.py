@@ -289,3 +289,50 @@ def test_training_read_gate_scoped_to_models(tmp_path):
         "    return list(store.find_events(ctx.registry, 'a'))\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_streaming_accumulation_gate_catches_module_state(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "streaming" / "leaky.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "_HISTORY = []\n"
+        "_SEEN: list = []\n"
+        "def tick(delta):\n"
+        "    _HISTORY.append(delta)\n"
+        "    _SEEN.extend(delta)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert ".append() into module-level '_HISTORY'" in kinds
+    assert ".extend() into module-level '_SEEN'" in kinds
+    assert "across refresh ticks" in kinds
+
+
+def test_streaming_accumulation_gate_allows_local_and_escape(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "streaming" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "_RING = []\n"
+        "def tick(deltas):\n"
+        "    batch = []\n"            # tick-local: dies with the tick
+        "    for d in deltas:\n"
+        "        batch.append(d)\n"
+        "    _RING.append(batch)  # lint: ok\n"
+        "    del _RING[:-8]\n"
+        "    return batch\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_streaming_accumulation_gate_scoped_to_streaming(tmp_path):
+    # outside streaming/ module-level accumulation is not per-tick
+    ok = tmp_path / "predictionio_tpu" / "core" / "registry.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "_ENGINES = []\n"
+        "def register(e):\n"
+        "    _ENGINES.append(e)\n"
+    )
+    assert not lint.run(tmp_path)

@@ -1,0 +1,637 @@
+"""Streaming freshness suite: delta-scan ingest, incremental fold-in,
+and the serve-path hot swap.
+
+Covers the streaming PR end-to-end the way an operator would run it:
+
+  - the PEVLOG delta scan is byte-equivalent to the tail of a full
+    scan, and everything that rewrites history between the watermark
+    snapshots (a delete's tombstone, an over-budget span, a driver with
+    no delta path) surfaces as `DeltaInvalidated`
+  - `fold_in_rows` matches the closed-form normal equations exactly
+    (explicit ALS-WR and implicit confidence semantics)
+  - template-level fold-in parity: untouched factor rows BIT-IDENTICAL,
+    touched users' top-k consistent with a full retrain, freshly rated
+    items actually surface
+  - the `Refresher` tick protocol against a live `PredictionServer`:
+    baseline -> noop -> folded, zero recompiles across the hot swap, a
+    brand-new user served without a redeploy, deletes and new items
+    falling back to the full rebuild
+  - chaos: the `streaming.refresh.swap` seam fires mid-commit and the
+    rollback keeps every in-flight client request succeeding, with the
+    same delta retried (and landed) on the next tick
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App, StorageRegistry
+from predictionio_tpu.data.storage.base import DeltaInvalidated
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.obs import compile_watch, get_registry
+from predictionio_tpu.ops import als
+from predictionio_tpu.ops.cooccur import CooccurrenceModel, merge_pair_counts
+from predictionio_tpu.resilience import FaultError, faults
+from predictionio_tpu.serving import PredictionServer, ServerConfig
+from predictionio_tpu.streaming import Refresher, scan_delta
+from predictionio_tpu.streaming.delta import Delta
+from predictionio_tpu.streaming.updaters import FoldContext, extend_bimap
+
+from test_serving import call
+
+pytestmark = pytest.mark.streaming
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults().clear()
+    yield
+    faults().clear()
+
+
+def pev_registry(tmp_path) -> StorageRegistry:
+    """SQLITE metadata + PEVLOG events: the delta-capable pairing."""
+    return StorageRegistry({
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_SOURCES_PEV_TYPE": "PEVLOG",
+        "PIO_STORAGE_SOURCES_PEV_PATH": str(tmp_path / "pevlog"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PEV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    })
+
+
+def _rate(user, item, rating):
+    return Event(
+        event="rate", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": float(rating)}))
+
+
+def _seed_ratings(events, app_id, n_users=12, n_items=9):
+    """Deterministic block structure: user u loves the i%3 == u%3
+    cluster — strong enough signal that fold-in and retrain agree on
+    what a user likes."""
+    rng = np.random.RandomState(7)
+    batch = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.rand() > 0.7:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            batch.append(_rate(f"u{u}", f"i{i}", r))
+    events.insert_batch(batch, app_id)
+
+
+@pytest.fixture()
+def trained_pev(tmp_path):
+    """PEVLOG-backed registry with a trained recommendation model and
+    the pieces a fold needs (store, app_id, components)."""
+    registry = pev_registry(tmp_path)
+    apps = registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "streamapp"))
+    registry.get_meta_data_access_keys().insert(AccessKey("SK", app_id, ()))
+    events = registry.get_events()
+    events.init(app_id)
+    _seed_ratings(events, app_id)
+    ctx = RuntimeContext(registry=registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="streamapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=6,
+                                           seed=1)),))
+    row = CoreWorkflow.run_train(engine, params, ctx)
+    return registry, engine, params, row, app_id
+
+
+def _cols_rows(cols):
+    """Order-free row multiset of an EventColumns (for equivalence)."""
+    return sorted(
+        (cols.entities[int(e)], cols.targets[int(t)], float(v), int(us))
+        for e, t, v, us in zip(cols.entity_ix, cols.target_ix,
+                               cols.value, cols.t_us))
+
+
+SPEC = dict(entity_type="user", event_names=["rate"],
+            value_spec={"*": 1.0}, require_target=True)
+
+
+class TestDeltaScan:
+    def test_delta_equals_tail_of_full_scan(self, trained_pev):
+        registry, _, _, _, app_id = trained_pev
+        events = registry.get_events()
+        wm1 = events.ingest_watermark(app_id)
+        events.insert_batch(
+            [_rate("u1", "i4", 5.0), _rate("u30", "i2", 3.0)], app_id)
+        wm2 = events.ingest_watermark(app_id)
+        assert wm2 != wm1
+        delta = events.scan_columns(app_id, since=wm1, upto=wm2, **SPEC)
+        full = events.scan_columns(app_id, **SPEC)
+        before = events.scan_columns(app_id, since=wm1, upto=wm1, **SPEC)
+        assert before.n == 0
+        assert delta.n == 2
+        assert set(delta.entities) == {"u1", "u30"}
+        # full == snapshot + delta, row for row
+        snap_rows = [r for r in _cols_rows(full)
+                     if r not in _cols_rows(delta)]
+        assert len(snap_rows) + delta.n == full.n
+
+    def test_delete_between_snapshots_invalidates(self, trained_pev):
+        """Satellite regression: a tombstone landing between the
+        watermarks means rows already folded into the since snapshot
+        may be dead — the delta path must refuse, forcing full-scan."""
+        registry, _, _, _, app_id = trained_pev
+        events = registry.get_events()
+        wm1 = events.ingest_watermark(app_id)
+        victim = next(iter(events.find(app_id, event_names=["rate"],
+                                       limit=1)))
+        assert events.delete(victim.event_id, app_id)
+        events.insert(_rate("u1", "i4", 5.0), app_id)
+        wm2 = events.ingest_watermark(app_id)
+        with pytest.raises(DeltaInvalidated, match="tombstone"):
+            events.scan_columns(app_id, since=wm1, upto=wm2, **SPEC)
+        # the full scan stays ground truth after the refusal
+        full = events.scan_columns(app_id, **SPEC)
+        assert victim.event_id not in {None}
+        assert full.n == sum(
+            1 for _ in events.find(app_id, event_names=["rate"]))
+
+    def test_base_driver_has_no_delta_path(self, mem_registry):
+        events = mem_registry.get_events()
+        events.init(1)
+        events.insert(_rate("u0", "i0", 5.0), 1)
+        with pytest.raises(DeltaInvalidated, match="no delta scan"):
+            events.scan_columns(1, since={}, upto={}, **SPEC)
+
+    def test_byte_budget_invalidates(self, trained_pev, monkeypatch):
+        registry, _, _, _, app_id = trained_pev
+        events = registry.get_events()
+        wm1 = events.ingest_watermark(app_id)
+        events.insert_batch([_rate("u1", f"i{i}", 2.0) for i in range(9)],
+                            app_id)
+        wm2 = events.ingest_watermark(app_id)
+        monkeypatch.setenv("PIO_DELTA_MAX_BYTES", "16")
+        with pytest.raises(DeltaInvalidated, match="PIO_DELTA_MAX_BYTES"):
+            events.scan_columns(app_id, since=wm1, upto=wm2, **SPEC)
+
+    def test_scan_delta_summary_and_touched_cap(self, trained_pev,
+                                                monkeypatch):
+        registry, _, _, _, app_id = trained_pev
+        events = registry.get_events()
+        wm1 = events.ingest_watermark(app_id)
+        events.insert_batch(
+            [_rate("u1", "i4", 5.0), _rate("u2", "i5", 4.0)], app_id)
+        wm2 = events.ingest_watermark(app_id)
+        d = scan_delta(events, app_id, None, wm1, wm2)
+        assert not d.empty and d.n_events == 2
+        assert set(d.touched_users) == {"u1", "u2"}
+        assert set(d.touched_items) == {"i4", "i5"}
+        assert d.newest_us > 0
+        monkeypatch.setenv("PIO_FOLD_MAX_TOUCHED", "1")
+        with pytest.raises(DeltaInvalidated, match="PIO_FOLD_MAX_TOUCHED"):
+            scan_delta(events, app_id, None, wm1, wm2)
+
+
+class TestFoldInRows:
+    def test_explicit_matches_normal_equations(self):
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=(16, 4)).astype(np.float32)
+        reg = 0.07
+        hists = [(np.array([1, 3, 5], np.int32),
+                  np.array([5.0, 1.0, 4.0], np.float32)),
+                 (np.array([2], np.int32), np.array([3.0], np.float32))]
+        rows = als.fold_in_rows(y, hists, reg=reg)
+        assert rows.shape == (2, 4)
+        for r, (ix, v) in enumerate(hists):
+            yh = y[ix]
+            a = yh.T @ yh + reg * len(ix) * np.eye(4, dtype=np.float32)
+            want = np.linalg.solve(a, yh.T @ v)
+            np.testing.assert_allclose(rows[r], want, atol=1e-4)
+
+    def test_implicit_matches_confidence_weighting(self):
+        rng = np.random.default_rng(1)
+        y = rng.normal(size=(12, 4)).astype(np.float32)
+        reg, alpha = 0.05, 2.0
+        ix = np.array([0, 4, 7], np.int32)
+        v = np.array([1.0, 1.0, 3.0], np.float32)
+        rows = als.fold_in_rows(y, [(ix, v)], reg=reg, implicit=True,
+                                alpha=alpha)
+        yh = y[ix]
+        conf = alpha * np.abs(v)                      # c - 1
+        a = (yh.T * conf) @ yh + y.T @ y \
+            + reg * len(ix) * np.eye(4, dtype=np.float32)
+        want = np.linalg.solve(a, yh.T @ (1.0 + conf))
+        np.testing.assert_allclose(rows[0], want, atol=1e-4)
+
+    def test_empty_histories(self):
+        y = np.ones((4, 3), np.float32)
+        assert als.fold_in_rows(y, [], reg=0.1).shape == (0, 3)
+
+
+class TestExtendBimap:
+    def test_stable_extension(self):
+        from predictionio_tpu.ingest.bimap import BiMap
+        base = BiMap.from_keys(["a", "b"])
+        ext = extend_bimap(base, ["b", "c", "c", "d"])
+        assert ext.get("a") == base.get("a")
+        assert ext.get("b") == base.get("b")
+        assert ext.get("c") == 2 and ext.get("d") == 3
+        assert extend_bimap(base, ["a"]) is base
+
+
+def _fold_fixture(trained_pev):
+    """(components, trained model, fold context factory)."""
+    registry, engine, params, _, app_id = trained_pev
+    ctx = RuntimeContext(registry=registry)
+    ds, prep, algos, _serving = engine.make_components(params)
+    pd = prep.prepare(ctx, ds.read_training(ctx))
+    model = algos[0].train(ctx, pd)
+    events = registry.get_events()
+
+    def fold(batch):
+        wm1 = events.ingest_watermark(app_id)
+        events.insert_batch(batch, app_id)
+        wm2 = events.ingest_watermark(app_id)
+        delta = scan_delta(events, app_id, None, wm1, wm2)
+        fctx = FoldContext(store=events, app_id=app_id, channel_id=None,
+                           since=wm1, upto=wm2,
+                           ds_params={"app_name": "streamapp"})
+        return algos[0].fold_in(model, delta, fctx)
+
+    return registry, ctx, (ds, prep, algos), model, events, app_id, fold
+
+
+class TestFoldInParity:
+    def test_untouched_bit_identical_touched_reranked(self, trained_pev):
+        registry, ctx, comps, model, events, app_id, fold = \
+            _fold_fixture(trained_pev)
+        ds, prep, algos = comps
+        # u1 turns coat: five-stars the i%3 == 2 cluster
+        loved = ["i2", "i5", "i8"]
+        folded = fold([_rate("u1", it, 5.0) for it in loved])
+        assert folded is not None
+        u1 = model.users.get("u1")
+        touched_items = {model.items.get(it) for it in loved}
+        # untouched user rows are bit-identical
+        for uid in model.users.keys():
+            ix = model.users.get(uid)
+            if uid == "u1":
+                continue
+            np.testing.assert_array_equal(
+                folded.user_factors[ix], model.user_factors[ix])
+        # untouched item rows are bit-identical too
+        for iid in model.items.keys():
+            ix = model.items.get(iid)
+            if ix in touched_items:
+                continue
+            np.testing.assert_array_equal(
+                folded.item_factors[ix], model.item_factors[ix])
+        assert not np.array_equal(folded.user_factors[u1],
+                                  model.user_factors[u1])
+        # the newly loved items now dominate u1's ranking
+        scores = folded.user_factors[u1] @ folded.item_factors.T
+        top3 = {int(i) for i in np.argsort(-scores)[:3]}
+        assert top3 & touched_items
+
+    def test_topk_parity_vs_full_retrain(self, trained_pev):
+        registry, ctx, comps, model, events, app_id, fold = \
+            _fold_fixture(trained_pev)
+        ds, prep, algos = comps
+        folded = fold([_rate("u1", "i2", 5.0), _rate("u1", "i5", 5.0)])
+        # ground truth: full retrain over the post-delta store
+        pd2 = prep.prepare(ctx, ds.read_training(ctx))
+        model2 = algos[0].train(ctx, pd2)
+        u1f = folded.users.get("u1")
+        u1r = model2.users.get("u1")
+        sf = folded.user_factors[u1f] @ folded.item_factors.T
+        sr = model2.user_factors[u1r] @ model2.item_factors.T
+        top_f = {folded.items.keys()[int(i)] for i in np.argsort(-sf)[:5]}
+        top_r = {model2.items.keys()[int(i)] for i in np.argsort(-sr)[:5]}
+        assert len(top_f & top_r) >= 3, (top_f, top_r)
+
+    def test_refold_deterministic_no_double_count(self, trained_pev):
+        """Touched rows are re-solved from FULL refetched history, not
+        incremented: the fold is a pure function of (model, store), so
+        re-running it from the same model is bit-identical, and
+        re-applying it to its own output (another exact ALS half-sweep)
+        still leaves every untouched row bit-identical."""
+        registry, ctx, comps, model, events, app_id, fold = \
+            _fold_fixture(trained_pev)
+        _, _, algos = comps
+        batch = [_rate("u1", "i2", 5.0)]
+        wm1 = events.ingest_watermark(app_id)
+        events.insert_batch(batch, app_id)
+        wm2 = events.ingest_watermark(app_id)
+        delta = scan_delta(events, app_id, None, wm1, wm2)
+        fctx = FoldContext(store=events, app_id=app_id, channel_id=None,
+                           since=wm1, upto=wm2,
+                           ds_params={"app_name": "streamapp"})
+        once_a = algos[0].fold_in(model, delta, fctx)
+        once_b = algos[0].fold_in(model, delta, fctx)
+        np.testing.assert_array_equal(once_a.user_factors,
+                                      once_b.user_factors)
+        np.testing.assert_array_equal(once_a.item_factors,
+                                      once_b.item_factors)
+        twice = algos[0].fold_in(once_a, delta, fctx)
+        u1 = model.users.get("u1")
+        i2 = model.items.get("i2")
+        for ix in range(len(model.users)):
+            if ix == u1:
+                continue
+            np.testing.assert_array_equal(twice.user_factors[ix],
+                                          model.user_factors[ix])
+        for ix in range(len(model.items)):
+            if ix == i2:
+                continue
+            np.testing.assert_array_equal(twice.item_factors[ix],
+                                          model.item_factors[ix])
+
+    def test_new_user_extends_new_item_invalidates(self, trained_pev):
+        registry, ctx, comps, model, events, app_id, fold = \
+            _fold_fixture(trained_pev)
+        folded = fold([_rate("fresh-user", "i2", 5.0)])
+        assert folded.users.get("fresh-user") is not None
+        assert len(folded.users) == len(model.users) + 1
+        assert folded.user_factors.shape[0] == len(folded.users)
+        with pytest.raises(DeltaInvalidated, match="item"):
+            fold([_rate("u1", "brand-new-item", 5.0)])
+
+
+class TestMergePairCounts:
+    def _model(self):
+        top_items = np.array([[1, 2, 0], [0, 2, 0], [0, 1, 0]], np.int32)
+        top_counts = np.array([[4.0, 2.0, 0.0], [4.0, 1.0, 0.0],
+                               [2.0, 1.0, 0.0]], np.float32)
+        return CooccurrenceModel(top_items, top_counts)
+
+    def test_merge_reranks_rows(self):
+        m = merge_pair_counts(self._model(), {(0, 2): 3.0})
+        # row 0: item2 count 2+3=5 overtakes item1's 4
+        assert list(m.top_items[0][:2]) == [2, 1]
+        assert list(m.top_counts[0][:2]) == [5.0, 4.0]
+        # symmetric: row 2 gains on item 0
+        assert m.top_counts[2][list(m.top_items[2]).index(0)] == 5.0
+        # row 1 untouched
+        np.testing.assert_array_equal(m.top_items[1],
+                                      self._model().top_items[1])
+
+    def test_new_entrant_and_self_pairs(self):
+        base = self._model()
+        m = merge_pair_counts(base, {(1, 1): 9.0})    # self-pair ignored
+        np.testing.assert_array_equal(m.top_counts, base.top_counts)
+        with pytest.raises(ValueError, match="full rebuild"):
+            merge_pair_counts(base, {(0, 7): 1.0})    # beyond catalog
+
+
+class TestHotSwapPlans:
+    def test_swap_reuses_executables_and_rolls_back(self):
+        from predictionio_tpu.ops import topk
+        rng = np.random.default_rng(5)
+        f0 = rng.integers(-4, 5, size=(10, 4)).astype(np.float32)
+        f1 = rng.integers(-4, 5, size=(10, 4)).astype(np.float32)
+        plan = topk.BucketedTopK(f0, k=3, buckets=(4,), banned_width=4)
+        plan.warm()
+        vecs = rng.integers(-4, 5, size=(2, 4)).astype(np.float32)
+        s0, ix0 = plan(vecs, [[], []])
+        with compile_watch() as w:
+            prev = plan.swap_factors(f1)
+            s1, ix1 = plan(vecs, [[], []])
+        assert w.count == 0
+        np.testing.assert_array_equal(prev, f0)
+        want = np.sort(vecs @ f1.T, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_array_equal(s1, want)
+        plan.swap_factors(prev)                       # rollback token
+        s2, ix2 = plan(vecs, [[], []])
+        np.testing.assert_array_equal(s2, s0)
+        np.testing.assert_array_equal(ix2, ix0)
+
+    def test_swap_rejects_shape_change(self):
+        from predictionio_tpu.ops import topk
+        plan = topk.BucketedTopK(np.ones((8, 4), np.float32), k=2,
+                                 buckets=(4,), banned_width=2)
+        plan.warm()
+        with pytest.raises(ValueError, match="re-warm"):
+            plan.swap_factors(np.ones((9, 4), np.float32))
+
+    @pytest.mark.sharded
+    def test_sharded_swap_parity(self):
+        import jax
+        from jax.sharding import Mesh
+        from predictionio_tpu.ops import topk, topk_sharded
+        mesh = Mesh(np.array(jax.devices()),
+                    (topk_sharded.SHARD_AXIS,))
+        rng = np.random.default_rng(6)
+        f0 = rng.integers(-4, 5, size=(37, 4)).astype(np.float32)
+        f1 = rng.integers(-4, 5, size=(37, 4)).astype(np.float32)
+        sharded = topk_sharded.ShardedBucketedTopK(
+            f0, k=3, buckets=(4,), banned_width=4, mesh=mesh)
+        sharded.warm()
+        host = topk.BucketedTopK(f1, k=3, buckets=(4,), banned_width=4)
+        host.warm()
+        vecs = rng.integers(-4, 5, size=(2, 4)).astype(np.float32)
+        with compile_watch() as w:
+            sharded.swap_factors(f1)
+            s_s, ix_s = sharded(vecs, [[], []])
+        assert w.count == 0
+        s_h, ix_h = host(vecs, [[], []])
+        np.testing.assert_array_equal(s_s, s_h)
+        np.testing.assert_array_equal(ix_s, ix_h)
+
+
+@pytest.fixture()
+def served(trained_pev):
+    registry, engine, _, _, app_id = trained_pev
+    srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                           registry=registry, engine=engine)
+    srv.start()
+    yield registry, srv, app_id
+    srv.shutdown()
+
+
+class TestRefresherServePath:
+    def test_tick_protocol_and_hot_swap(self, served):
+        registry, srv, app_id = served
+        events = registry.get_events()
+        assert srv._refresher is None          # disabled by default
+        r = Refresher(srv, interval_s=999.0)   # manual ticks only
+        assert r.tick() == "baseline"
+        assert r.tick() == "noop"
+        # a brand-new user lands mid-flight...
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "fresh-user", "num": 3})
+        assert status == 200 and body["itemScores"] == []
+        events.insert_batch(
+            [_rate("fresh-user", it, 5.0) for it in ("i2", "i5")], app_id)
+        old_models = srv._dep.models
+        with compile_watch() as w:
+            assert r.tick() == "folded"        # hot swap, zero recompiles
+        assert w.count == 0
+        assert srv._dep.models is not old_models
+        # ...and is served WITHOUT a retrain or redeploy
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "fresh-user", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+        fresh = get_registry().value("pio_freshness_seconds")
+        assert fresh is not None and 0.0 <= fresh < 120.0
+        # watermark advanced: the same tick is now a noop
+        assert r.tick() == "noop"
+
+    def test_delete_forces_full_rebuild(self, served):
+        """Satellite regression at the serve path: a delete between
+        snapshots invalidates the fold and the refresher falls back to
+        the full-scan rebuild, still serving throughout."""
+        registry, srv, app_id = served
+        events = registry.get_events()
+        r = Refresher(srv, interval_s=999.0)
+        assert r.tick() == "baseline"
+        victim = next(iter(events.find(app_id, event_names=["rate"],
+                                       limit=1)))
+        assert events.delete(victim.event_id, app_id)
+        assert r.tick() == "full_rebuild"
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+
+    def test_new_item_forces_full_rebuild(self, served):
+        registry, srv, app_id = served
+        events = registry.get_events()
+        r = Refresher(srv, interval_s=999.0)
+        assert r.tick() == "baseline"
+        events.insert(_rate("u1", "i-new", 5.0), app_id)
+        assert r.tick() == "full_rebuild"
+        # the rebuilt model knows the new item
+        assert srv._dep.models[0].items.get("i-new") is not None
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+
+    def test_stagger_delays_first_tick(self, served):
+        _, srv, _ = served
+        r = Refresher(srv, interval_s=999.0, stagger_s=999.0)
+        r.start()
+        try:
+            assert r.last_outcome == ""        # still inside the stagger
+        finally:
+            r.stop()
+
+    def test_server_config_enables_refresher(self, trained_pev):
+        registry, engine, _, _, _ = trained_pev
+        srv = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, refresh_interval_s=900.0,
+                         refresh_stagger_s=900.0),
+            registry=registry, engine=engine)
+        try:
+            assert srv._refresher is not None
+            assert srv._refresher.interval_s == 900.0
+            assert srv._refresher.stagger_s == 900.0
+        finally:
+            srv.stop()                 # graceful path stops the loop
+        assert srv._refresher._stop.is_set()
+
+    def test_fleet_replica_stagger_math(self):
+        from predictionio_tpu.serving.fleet import FleetConfig, FleetServer
+        fs = FleetServer.__new__(FleetServer)
+        fs.config = ServerConfig(ip="127.0.0.1", port=0,
+                                 refresh_interval_s=60.0)
+        fs.fleet = FleetConfig(replicas=3)
+        offs = [fs._replica_config(i).refresh_stagger_s for i in range(3)]
+        assert offs == [0.0, 20.0, 40.0]
+        fs.config = ServerConfig(ip="127.0.0.1", port=0)
+        assert fs._replica_config(2).refresh_stagger_s == 0.0
+
+
+@pytest.mark.chaos
+class TestRefreshChaos:
+    def test_swap_fault_rolls_back_with_zero_failed_requests(self, served):
+        registry, srv, app_id = served
+        events = registry.get_events()
+        r = Refresher(srv, interval_s=999.0)
+        assert r.tick() == "baseline"
+        events.insert_batch(
+            [_rate("fresh-user", it, 5.0) for it in ("i2", "i5")], app_id)
+        faults().arm("streaming.refresh.swap", error=FaultError, times=1)
+        failures, stop = [], threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                status, _ = call(srv.port, "POST", "/queries.json",
+                                 {"user": "u1", "num": 3})
+                if status != 200:
+                    failures.append(status)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            old_models = srv._dep.models
+            assert r.tick() == "rolled_back"
+            # last-good keeps serving; the fold was never published
+            assert srv._dep.models is old_models
+            # the watermark did NOT advance: the SAME delta retries and
+            # lands once the seam is spent
+            assert r.tick() == "folded"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not failures
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "fresh-user", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+        assert get_registry().value("pio_streaming_refresh_total",
+                                    outcome="rolled_back") >= 1
+
+
+class TestWarmStart:
+    def test_twotower_resumes_from_params(self):
+        from predictionio_tpu.ops.twotower import twotower_train
+        rng = np.random.default_rng(2)
+        u = rng.integers(0, 6, size=64).astype(np.int64)
+        i = rng.integers(0, 5, size=64).astype(np.int64)
+        m0 = twotower_train(u, i, n_users=6, n_items=5, emb_dim=8,
+                            hidden=8, out_dim=8, batch_size=32, epochs=1,
+                            seed=0)
+        assert m0.params is not None
+        m1 = twotower_train(u, i, n_users=6, n_items=5, emb_dim=8,
+                            hidden=8, out_dim=8, batch_size=32, epochs=1,
+                            seed=0, init_params=m0.params)
+        for k in m0.params:
+            assert m1.params[k].shape == m0.params[k].shape
+        # the mini-epoch moved the weights, not re-initialized them
+        drift = max(float(np.max(np.abs(m1.params[k] - m0.params[k])))
+                    for k in m0.params)
+        assert 0.0 < drift < 1.0
+
+    def test_seqrec_resumes_from_params(self):
+        import jax
+        from predictionio_tpu.ops.seqrec import (
+            build_sequences, seqrec_train,
+        )
+        rng = np.random.default_rng(3)
+        n = 80
+        users = np.repeat(np.arange(8), 10).astype(np.int64)
+        items = rng.integers(0, 6, size=n).astype(np.int64)
+        t = np.arange(n, dtype=np.int64) * 1000
+        seqs, targets = build_sequences(users, items, t, n_items=6,
+                                        seq_len=8)
+        m0 = seqrec_train(seqs, targets, n_items=6, seq_len=8, dim=8,
+                          n_heads=2, n_layers=1, batch_size=4, epochs=1,
+                          seed=0)
+        m1 = seqrec_train(seqs, targets, n_items=6, seq_len=8, dim=8,
+                          n_heads=2, n_layers=1, batch_size=4, epochs=1,
+                          seed=0, init_params=m0.params)
+        leaves0 = jax.tree_util.tree_leaves(m0.params)
+        leaves1 = jax.tree_util.tree_leaves(m1.params)
+        assert [l.shape for l in leaves0] == [l.shape for l in leaves1]
+
+
+class TestDeltaDataclass:
+    def test_empty_flag(self):
+        d = Delta({}, {}, (), (), 0, 0)
+        assert d.empty
+        assert not Delta({}, {}, ("u",), ("i",), 1, 5).empty
