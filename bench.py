@@ -837,6 +837,168 @@ def bench_serving(u, i, r, n_users, n_items):
         server.shutdown()
 
 
+def _post_keyed(port, key, payload, timeout=10):
+    """POST /queries.json with an app access key; returns the HTTP
+    status (429/5xx are DATA here, not errors — the tenancy bench
+    counts sheds instead of failing on them)."""
+    import urllib.error
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json?accessKey={key}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+            return resp.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except OSError:
+        return -1
+
+
+class _PoissonLoad:
+    """OPEN-LOOP Poisson load: requests fire on the arrival schedule no
+    matter how slowly responses return. A closed-loop hammer would
+    self-throttle the moment the server slows down and hide exactly the
+    overload this bench exists to measure (coordinated omission)."""
+
+    def __init__(self, port, key, rps, duration_s, n_users, seed):
+        self.port, self.key = port, key
+        self.rps, self.duration_s = rps, duration_s
+        self.n_users = n_users
+        self.rng = np.random.RandomState(seed)
+        self.samples = []            # (status, latency_s)
+        self._lock = threading.Lock()
+        self._fired = []
+
+    def _fire(self, n):
+        t0 = time.perf_counter()
+        status = _post_keyed(self.port, self.key,
+                             {"user": f"u{n % self.n_users}", "num": 5})
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.samples.append((status, dt))
+
+    def run(self):
+        """Blocks for `duration_s`, then joins every in-flight request."""
+        t_end = time.perf_counter() + self.duration_s
+        n = 0
+        while True:
+            gap = float(self.rng.exponential(1.0 / self.rps))
+            now = time.perf_counter()
+            if now + gap >= t_end:
+                break
+            time.sleep(gap)
+            t = threading.Thread(target=self._fire, args=(n,), daemon=True)
+            t.start()
+            self._fired.append(t)
+            n += 1
+        for t in self._fired:
+            t.join(15)
+
+    def stats(self):
+        with self._lock:
+            lats = [dt for s, dt in self.samples if s == 200]
+            by = {}
+            for s, _ in self.samples:
+                by[s] = by.get(s, 0) + 1
+        p99 = float(np.percentile(lats, 99)) * 1e3 if lats else float("inf")
+        return by, p99
+
+
+def bench_tenancy(u, i, r, n_users, n_items):
+    """Multi-tenant overload isolation, measured open-loop: a victim
+    app inside its quota and an aggressor at 10x the victim's rate hit
+    the SAME tenancy-enabled server. Hard gates (SystemExit on miss):
+
+      - zero victim drops: every victim request answers 200 while the
+        aggressor floods (the DRR lanes + per-app quota keep the
+        victim's path clear)
+      - victim p99 under contention <= 2x its no-contention p99 (with
+        a 5 ms noise floor — sub-ms CPU serves jitter more than 2x)
+      - the aggressor's overflow sheds under surface=quota (429), not
+        by starving the victim
+    """
+    from predictionio_tpu.data.storage import AccessKey, App, TenantQuota
+    from predictionio_tpu.obs import get_registry
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+    from predictionio_tpu.tenancy import TenancyConfig
+
+    registry, engine = _train_registry(u, i, r, n_users, n_items)
+    apps = registry.get_meta_data_apps()
+    victim_id = apps.get_by_name("benchapp").id
+    registry.get_meta_data_access_keys().insert(
+        AccessKey("VICTIM_KEY", victim_id, ()))
+    aggro_id = apps.insert(App(0, "aggressor"))
+    registry.get_meta_data_access_keys().insert(
+        AccessKey("AGGRO_KEY", aggro_id, ()))
+
+    victim_rps, duration_s = 25.0, 4.0
+    if remaining() < 90:
+        duration_s = 2.0
+        print("# budget: tenancy phases shrunk to 2s", file=sys.stderr)
+    # the aggressor arrives at 10x the victim's rate but its quota
+    # admits roughly the victim's rate — ~90% of its load MUST shed
+    registry.get_meta_data_tenant_quotas().upsert(
+        TenantQuota(appid=aggro_id, rate=30.0, burst=15.0))
+
+    server = PredictionServer(
+        ServerConfig(ip="127.0.0.1", port=0, batch_window_ms=2,
+                     tenancy=TenancyConfig(enabled=True, rate=1e5,
+                                           burst=1e5)),
+        registry=registry, engine=engine)
+    server.start()
+    try:
+        for n in range(20):                      # warm compile + sockets
+            _post_keyed(server.port, "VICTIM_KEY",
+                        {"user": f"u{n}", "num": 5})
+
+        solo = _PoissonLoad(server.port, "VICTIM_KEY", victim_rps,
+                            duration_s, n_users, seed=1)
+        solo.run()
+        solo_by, solo_p99 = solo.stats()
+
+        victim = _PoissonLoad(server.port, "VICTIM_KEY", victim_rps,
+                              duration_s, n_users, seed=2)
+        aggro = _PoissonLoad(server.port, "AGGRO_KEY", victim_rps * 10,
+                             duration_s, n_users, seed=3)
+        at = threading.Thread(target=aggro.run, daemon=True)
+        at.start()
+        victim.run()
+        at.join(duration_s + 20)
+        vic_by, vic_p99 = victim.stats()
+        agg_by, _ = aggro.stats()
+    finally:
+        server.shutdown()
+
+    shed_quota = get_registry().value("pio_shed_total", surface="quota",
+                                      app="aggressor")
+    emit("tenancy_victim_p99_solo", solo_p99, "ms", 1.0)
+    emit("tenancy_victim_p99_contended", vic_p99, "ms",
+         solo_p99 / vic_p99 if vic_p99 > 0 else 1.0)
+    victim_drops = sum(c for s, c in vic_by.items() if s != 200)
+    emit("tenancy_victim_drops", float(victim_drops), "requests", 1.0)
+    emit("tenancy_aggressor_shed_quota", float(shed_quota), "requests",
+         1.0)
+
+    if solo_by.get(200, 0) == 0 or vic_by.get(200, 0) == 0:
+        raise SystemExit(f"tenancy bench produced no victim traffic: "
+                         f"solo={solo_by} contended={vic_by}")
+    if victim_drops:
+        raise SystemExit(
+            f"tenancy gate FAILED: {victim_drops} victim requests lost "
+            f"under aggressor overload (statuses {vic_by})")
+    if vic_p99 > 2.0 * max(solo_p99, 5.0):
+        raise SystemExit(
+            f"tenancy gate FAILED: victim p99 {vic_p99:.1f}ms under "
+            f"contention vs {solo_p99:.1f}ms solo (> 2x)")
+    if shed_quota <= 0 or agg_by.get(429, 0) == 0:
+        raise SystemExit(
+            f"tenancy gate FAILED: aggressor at 10x quota never shed "
+            f"under surface=quota (statuses {agg_by})")
+
+
 def bench_fleet(u, i, r, n_users, n_items):
     """Open-loop client load against a 3-replica fleet WHILE a rolling
     /reload cycles every replica (eject -> drain -> reload -> re-admit).
@@ -2497,6 +2659,14 @@ def _setup_runtime():
     import subprocess
 
     signal.signal(signal.SIGTERM, _on_sigterm)
+    # Dispatch-state persistence off for the whole bench run: restored
+    # EWMAs / batch-size histograms from a PREVIOUS run (or an earlier
+    # section in this one — fleet rolling reloads re-save mid-run) would
+    # warm-start dispatch policy and narrow warm buckets from foreign
+    # traffic, making sections non-reproducible and tripping the
+    # zero-steady-state-recompile gates. setdefault so an operator can
+    # still point PIO_DISPATCH_STATE somewhere to bench the feature.
+    os.environ.setdefault("PIO_DISPATCH_STATE", "off")
     try:
         import jax
         cache_dir = os.path.join(os.path.dirname(
@@ -2562,6 +2732,10 @@ def main():
     if "--only-streaming" in sys.argv:
         section(bench_streaming_freshness)
         return
+    if "--only-tenancy" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_tenancy, u, i, r, n_users, n_items)
+        return
     if "--only-configs" in sys.argv:   # BASELINE configs 2-5 + seqrec
         section(bench_classification)
         section(bench_similarproduct)
@@ -2588,6 +2762,7 @@ def main():
         section(bench_twotower)
         section(bench_seqrec)
         section(bench_serving, u, i, r, n_users, n_items)
+        section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)
         section(bench_ecommerce_scale)

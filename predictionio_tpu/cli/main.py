@@ -9,6 +9,7 @@ sqlite default) via the process-default registry.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import signal
 import sys
@@ -58,6 +59,26 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("app_name")
     x.add_argument("channel_name")
     x.add_argument("--force", "-f", action="store_true")
+    x = app.add_parser(
+        "quota-set",
+        help="persist a per-app serving admission override (rate/"
+             "burst/concurrency/queue/weight); unset fields inherit "
+             "the PIO_TENANT_* defaults")
+    x.add_argument("name")
+    x.add_argument("--rate", type=float,
+                   help="token-bucket refill, requests/second")
+    x.add_argument("--burst", type=float,
+                   help="token-bucket capacity, requests")
+    x.add_argument("--concurrency", type=int,
+                   help="in-flight cap (0 = unlimited)")
+    x.add_argument("--queue-max", type=int,
+                   help="per-tenant micro-batch pending cap")
+    x.add_argument("--weight", type=float,
+                   help="weighted-fair drain weight (default 1.0)")
+    x = app.add_parser("quota-show")
+    x.add_argument("name")
+    x = app.add_parser("quota-delete")
+    x.add_argument("name")
 
     # accesskey ------------------------------------------------------------
     ak = sub.add_parser("accesskey", help="manage access keys"
@@ -136,6 +157,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "ticks (0 = disabled; PIO_REFRESH_INTERVAL_S "
                         "applies when unset). Replicas of a fleet "
                         "stagger their ticks automatically")
+    x.add_argument("--tenancy", choices=["on", "off"],
+                   help="multi-tenant admission on /queries.json: "
+                        "authenticate app access keys, enforce per-app "
+                        "rate/concurrency quotas (429 + Retry-After), "
+                        "and drain the micro-batch queue weighted-fair "
+                        "across apps (default: the PIO_TENANCY env/"
+                        "config knob, off when unset)")
+    x.add_argument("--tenant-rate", type=float,
+                   help="default per-app rate quota, requests/second "
+                        "(PIO_TENANT_RATE)")
+    x.add_argument("--tenant-burst", type=float,
+                   help="default per-app token-bucket burst "
+                        "(PIO_TENANT_BURST)")
+    x.add_argument("--tenant-concurrency", type=int,
+                   help="default per-app in-flight cap, 0 = unlimited "
+                        "(PIO_TENANT_CONCURRENCY)")
+    x.add_argument("--tenant-queue-max", type=int,
+                   help="default per-app micro-batch pending cap "
+                        "(PIO_TENANT_QUEUE_MAX)")
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
@@ -291,10 +331,26 @@ def main(argv: Optional[list] = None) -> int:
                 FleetServer, PredictionServer, ReplicaAgent, ServerConfig,
                 fleet_config_from_env,
             )
+            from predictionio_tpu.tenancy import TenancyConfig
             variant = ops.load_variant(args.engine_json)
             factory = ops.resolve_factory_name(variant, args.engine_factory,
                                                args.engine_json)
             registry = _registry()
+            # layered: pio-env/env PIO_TENANCY + PIO_TENANT_* defaults,
+            # explicit deploy flags win
+            tenancy_overrides = {}
+            if args.tenancy:
+                tenancy_overrides["enabled"] = args.tenancy == "on"
+            if args.tenant_rate is not None:
+                tenancy_overrides["rate"] = args.tenant_rate
+            if args.tenant_burst is not None:
+                tenancy_overrides["burst"] = args.tenant_burst
+            if args.tenant_concurrency is not None:
+                tenancy_overrides["concurrency"] = args.tenant_concurrency
+            if args.tenant_queue_max is not None:
+                tenancy_overrides["queue_max"] = args.tenant_queue_max
+            tenancy = TenancyConfig.from_env(registry.config,
+                                             **tenancy_overrides)
             config = ServerConfig(
                 ip=args.ip, port=args.port, engine_factory=factory,
                 engine_variant=variant.get("id", "default"),
@@ -305,10 +361,15 @@ def main(argv: Optional[list] = None) -> int:
                 batch_window_ms=args.batch_window_ms,
                 mesh=args.mesh or "",
                 refresh_interval_s=args.refresh_interval,
-                server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""))
+                server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""),
+                tenancy=tenancy)
             if args.join:
                 # standalone replica: serve locally, register with (and
-                # heartbeat) every router listed
+                # heartbeat) every router listed. The joined routers are
+                # the auth + quota boundary; this replica trusts their
+                # X-PIO-App assertion and applies only the fairness layer
+                config = dataclasses.replace(
+                    config, tenancy=tenancy.replica_variant())
                 server = PredictionServer(config, registry=registry)
                 port = server.start()
                 fc = fleet_config_from_env(registry.config)
@@ -507,6 +568,16 @@ def _app(args) -> int:
         ops.channel_delete(registry, args.app_name, args.channel_name,
                            force=args.force)
         _emit({"message": f"Channel {args.channel_name} deleted"})
+    elif c == "quota-set":
+        _emit(ops.app_quota_set(
+            registry, args.name, rate=args.rate, burst=args.burst,
+            concurrency=args.concurrency, queue_max=args.queue_max,
+            weight=args.weight))
+    elif c == "quota-show":
+        _emit(ops.app_quota_show(registry, args.name))
+    elif c == "quota-delete":
+        ops.app_quota_delete(registry, args.name)
+        _emit({"message": f"Quota override for {args.name} deleted"})
     return 0
 
 

@@ -60,6 +60,50 @@ def app_show(registry, name: str) -> Dict[str, Any]:
     }
 
 
+def app_quota_set(registry, name: str, *,
+                  rate: Optional[float] = None,
+                  burst: Optional[float] = None,
+                  concurrency: Optional[int] = None,
+                  queue_max: Optional[int] = None,
+                  weight: Optional[float] = None) -> Dict[str, Any]:
+    """Persist a per-app admission override (serving tenancy). Only
+    the fields given override the fleet-wide PIO_TENANT_* defaults;
+    the rest stay None and keep inheriting. Running servers pick the
+    change up within the admission TTL — no redeploy."""
+    from predictionio_tpu.data.storage import TenantQuota
+    app = _require_app(registry, name)
+    quotas = registry.get_meta_data_tenant_quotas()
+    existing = quotas.get(app.id)
+    fields = dict(rate=rate, burst=burst, concurrency=concurrency,
+                  queue_max=queue_max, weight=weight)
+    if existing is not None:
+        for k, v in list(fields.items()):
+            if v is None:
+                fields[k] = getattr(existing, k)
+    quota = TenantQuota(appid=app.id, **fields)
+    quotas.upsert(quota)
+    return app_quota_show(registry, name)
+
+
+def app_quota_show(registry, name: str) -> Dict[str, Any]:
+    """The app's stored admission override (unset fields inherit the
+    PIO_TENANT_* defaults at the serving tier)."""
+    app = _require_app(registry, name)
+    quota = registry.get_meta_data_tenant_quotas().get(app.id)
+    row = {"rate": None, "burst": None, "concurrency": None,
+           "queue_max": None, "weight": None}
+    if quota is not None:
+        row = {k: getattr(quota, k) for k in row}
+    return {"name": app.name, "id": app.id, "quota": row,
+            "note": "null fields inherit the PIO_TENANT_* defaults"}
+
+
+def app_quota_delete(registry, name: str) -> None:
+    """Drop the app's override; defaults apply again."""
+    app = _require_app(registry, name)
+    registry.get_meta_data_tenant_quotas().delete(app.id)
+
+
 def app_delete(registry, name: str, *, force: bool = False) -> None:
     app = _require_app(registry, name)
     if not force:

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import importlib
 import threading
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.core.engine import Engine, EngineFactory
 from predictionio_tpu.core.params import EngineParams
@@ -206,7 +206,8 @@ class CoreWorkflow:
     def prepare_deploy(engine: Engine, instance: EngineInstance,
                        ctx: RuntimeContext,
                        engine_params: Optional[EngineParams] = None,
-                       *, warm_batch_max: Optional[int] = None
+                       *, warm_batch_max: Optional[int] = None,
+                       observed_sizes: Optional[Dict[int, int]] = None
                        ) -> Tuple[List[Any], List[Any], Any]:
         """Load (or retrain) the instance's models for serving; returns
         (algorithms, models, serving). (Engine.prepareDeploy +
@@ -214,7 +215,10 @@ class CoreWorkflow:
 
         `warm_batch_max` caps the batch buckets AOT-warmed through each
         algorithm's `warm_serving` hook (the server passes its
-        micro-batcher `batch_max`); None skips warmup entirely."""
+        micro-batcher `batch_max`); None skips warmup entirely.
+        `observed_sizes` (pow2 batch size -> drain count, the
+        micro-batcher's persisted histogram) narrows warmup to the
+        shapes real traffic actually formed."""
         if engine_params is None:
             engine_params = engine_params_from_instance(engine, instance)
         from predictionio_tpu.core.engine import bind_serving_context
@@ -247,12 +251,47 @@ class CoreWorkflow:
             conf = {**dict(getattr(instance, "runtime_conf", None) or {}),
                     **dict(ctx.workflow_params.runtime_conf or {})}
             warm_deploy(algos, models, warm_batch_max,
-                        mesh=serve_mesh_from_conf(conf))
+                        mesh=serve_mesh_from_conf(conf),
+                        observed_sizes=observed_sizes)
         return algos, models, serving
 
 
+def derive_warm_buckets(warm_batch_max: int,
+                        observed_sizes: Optional[Dict[int, int]] = None
+                        ) -> List[int]:
+    """The batch shapes a deploy should AOT-compile.
+
+    No observation history -> the full pow2 ladder 1..warm_batch_max
+    (cold start must handle anything). With a recorded batch-size
+    histogram, only the observed pow2 shapes (clamped to the ladder)
+    plus bucket 1 — the single-query shape every dispatch can fall back
+    to — get compiled, cutting deploy warmup time on workloads that
+    never form the big batches."""
+    cap = max(1, int(warm_batch_max))
+    ladder: List[int] = []
+    b = 1
+    while b <= cap:
+        ladder.append(b)
+        b *= 2
+    if not observed_sizes:
+        return ladder
+    wanted = {1}
+    for size, count in observed_sizes.items():
+        try:
+            size, count = int(size), int(count)
+        except (TypeError, ValueError):
+            continue
+        if count <= 0 or size < 1:
+            continue
+        # clamp outsized observations (batch_max shrank between runs)
+        # onto the largest ladder shape
+        wanted.add(max(s for s in ladder if s <= size))
+    return [s for s in ladder if s in wanted]
+
+
 def warm_deploy(algos: List[Any], models: List[Any],
-                warm_batch_max: int, mesh=None) -> int:
+                warm_batch_max: int, mesh=None,
+                observed_sizes: Optional[Dict[int, int]] = None) -> int:
     """AOT-warm every algorithm's serve executables for the power-of-two
     batch buckets up to `warm_batch_max`, pinning model state device
     resident, so steady-state serving never recompiles. `mesh` (a
@@ -273,11 +312,7 @@ def warm_deploy(algos: List[Any], models: List[Any],
     # compiles during warmup must be attributed (and post-warmup drift
     # detectable), so the probe goes in before the first lowering
     install_compile_probe()
-    buckets: List[int] = []
-    b = 1
-    while b <= max(1, int(warm_batch_max)):
-        buckets.append(b)
-        b *= 2
+    buckets = derive_warm_buckets(warm_batch_max, observed_sizes)
     from predictionio_tpu.obs import get_registry
     reg = get_registry()
     t0 = _time.perf_counter()

@@ -8,7 +8,7 @@ from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
     EngineInstanceStatus, EngineInstances, EvaluationInstance,
     EvaluationInstanceStatus, EvaluationInstances, EventStore, Lease, Leases,
-    Model, Models, StorageError, StorageWriteError,
+    Model, Models, StorageError, StorageWriteError, TenantQuota, TenantQuotas,
 )
 from predictionio_tpu.data.storage.registry import (
     StorageRegistry, register_driver, set_default, storage,
@@ -19,6 +19,6 @@ __all__ = [
     "EngineInstance", "EngineInstanceStatus", "EngineInstances",
     "EvaluationInstance", "EvaluationInstanceStatus", "EvaluationInstances",
     "EventStore", "Lease", "Leases", "Model", "Models", "StorageError",
-    "StorageWriteError",
+    "StorageWriteError", "TenantQuota", "TenantQuotas",
     "StorageRegistry", "register_driver", "set_default", "storage",
 ]

@@ -182,6 +182,33 @@ class Lease:
         return (now or utcnow()) >= self.expires_at
 
 
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-app admission-control override row (no reference analog —
+    the reference is multi-app on ingest only; serve-side quotas are
+    this port's million-user follow-on). Every field except `appid` is
+    Optional: None means 'inherit the server-wide default' so an
+    operator can raise one knob without freezing the rest."""
+    appid: int
+    rate: Optional[float] = None         # token-bucket refill, req/s
+    burst: Optional[float] = None        # bucket capacity, requests
+    concurrency: Optional[int] = None    # in-flight cap (0 = unlimited)
+    queue_max: Optional[int] = None      # micro-batch pending cap
+    weight: Optional[float] = None       # DRR drain weight
+
+    def merged_over(self, other: "TenantQuota") -> "TenantQuota":
+        """This row's explicit fields over `other`'s (defaults)."""
+        return TenantQuota(
+            appid=self.appid,
+            rate=self.rate if self.rate is not None else other.rate,
+            burst=self.burst if self.burst is not None else other.burst,
+            concurrency=(self.concurrency if self.concurrency is not None
+                         else other.concurrency),
+            queue_max=(self.queue_max if self.queue_max is not None
+                       else other.queue_max),
+            weight=self.weight if self.weight is not None else other.weight)
+
+
 # ---------------------------------------------------------------------------
 # DAO interfaces
 # ---------------------------------------------------------------------------
@@ -368,6 +395,25 @@ class Leases(abc.ABC):
         """Delete the row iff `holder` still owns it. True when the
         row was deleted (a graceful step-down); False when someone
         else holds it or it is gone already."""
+
+
+class TenantQuotas(abc.ABC):
+    """Per-app quota-override CRUD on the metadata store, read by the
+    serving admission controller (cached, so a write lands within its
+    refresh interval, not instantly)."""
+
+    @abc.abstractmethod
+    def upsert(self, quota: TenantQuota) -> None:
+        """Insert or fully replace the override row for `quota.appid`."""
+
+    @abc.abstractmethod
+    def get(self, appid: int) -> Optional[TenantQuota]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[TenantQuota]: ...
+
+    @abc.abstractmethod
+    def delete(self, appid: int) -> None: ...
 
 
 # ---------------------------------------------------------------------------

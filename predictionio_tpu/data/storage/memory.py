@@ -17,7 +17,7 @@ from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Lease, Model,
-    _UNSET, match_event,
+    TenantQuota, _UNSET, match_event,
 )
 
 
@@ -34,6 +34,7 @@ class MemStorageClient:
         self.evaluation_instances: Dict[str, EvaluationInstance] = {}
         self.models: Dict[str, Model] = {}
         self.leases: Dict[str, Lease] = {}
+        self.tenant_quotas: Dict[int, TenantQuota] = {}
         # (app_id, channel_id) -> event_id -> Event
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
         self._app_seq = itertools.count(1)
@@ -223,6 +224,28 @@ class MemModels(base.Models):
     def list_model_ids(self) -> List[str]:
         with self.c.lock:
             return sorted(self.c.models)
+
+
+class MemTenantQuotas(base.TenantQuotas):
+    def __init__(self, client: MemStorageClient):
+        self.c = client
+
+    def upsert(self, quota: TenantQuota) -> None:
+        with self.c.lock:
+            self.c.tenant_quotas[quota.appid] = quota
+
+    def get(self, appid: int) -> Optional[TenantQuota]:
+        with self.c.lock:
+            return self.c.tenant_quotas.get(appid)
+
+    def get_all(self) -> List[TenantQuota]:
+        with self.c.lock:
+            return [self.c.tenant_quotas[k]
+                    for k in sorted(self.c.tenant_quotas)]
+
+    def delete(self, appid: int) -> None:
+        with self.c.lock:
+            self.c.tenant_quotas.pop(appid, None)
 
 
 class MemLeases(base.Leases):

@@ -52,6 +52,7 @@ def _register_builtin_drivers() -> None:
         "EvaluationInstances": memory.MemEvaluationInstances,
         "Models": memory.MemModels,
         "Leases": memory.MemLeases,
+        "TenantQuotas": memory.MemTenantQuotas,
         "Events": memory.MemEvents,
     })
     register_driver("SQLITE", sqlite.SQLiteStorageClient, {
@@ -62,6 +63,7 @@ def _register_builtin_drivers() -> None:
         "EvaluationInstances": sqlite.SQLiteEvaluationInstances,
         "Models": sqlite.SQLiteModels,
         "Leases": sqlite.SQLiteLeases,
+        "TenantQuotas": sqlite.SQLiteTenantQuotas,
         "Events": sqlite.SQLiteEvents,
     })
     register_driver("LOCALFS", localfs.LocalFSStorageClient, {
@@ -95,6 +97,7 @@ def _register_builtin_drivers() -> None:
             "EngineInstances": postgres.PostgresEngineInstances,
             "EvaluationInstances": postgres.PostgresEvaluationInstances,
             "Models": postgres.PostgresModels,
+            "TenantQuotas": postgres.PostgresTenantQuotas,
             "Events": postgres.PostgresEvents,
         })
 
@@ -333,6 +336,12 @@ class StorageRegistry:
         DAO (object stores) raise StorageError — the fleet degrades to
         always-leader with a warning."""
         return self._repo_dao("MODELDATA", "Leases")
+
+    def get_meta_data_tenant_quotas(self) -> base.TenantQuotas:
+        """Per-app admission-override DAO. Sources whose driver has no
+        TenantQuotas DAO raise StorageError — the serving admission
+        controller degrades to its env/CLI defaults with a warning."""
+        return self._repo_dao("METADATA", "TenantQuotas")
 
     def get_events(self) -> base.EventStore:
         """The LEvents/PEvents analog (training reads go through ingest/)."""

@@ -24,7 +24,8 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
-    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model, _UNSET,
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+    TenantQuota, _UNSET,
     match_properties as _match_properties,
 )
 
@@ -66,6 +67,9 @@ META_DDL = (
     """CREATE TABLE IF NOT EXISTS leases (
         name TEXT PRIMARY KEY, holder TEXT NOT NULL,
         expires_ms INTEGER NOT NULL, journal TEXT NOT NULL)""",
+    """CREATE TABLE IF NOT EXISTS tenant_quotas (
+        appid INTEGER PRIMARY KEY, rate REAL, burst REAL,
+        concurrency INTEGER, queue_max INTEGER, weight REAL)""",
 )
 
 # Additive schema migrations for stores created before a column existed;
@@ -487,6 +491,43 @@ class SQLiteModels(base.Models):
                           f"{retention_s:.0f}s retention",
                 "action": "deleted"})
         return findings
+
+
+class SQLiteTenantQuotas(base.TenantQuotas):
+    """Per-app admission overrides; NULL columns inherit the server
+    defaults, so an operator can pin one knob per app."""
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    _COLS = "appid, rate, burst, concurrency, queue_max, weight"
+
+    def upsert(self, quota: TenantQuota) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                f"INSERT OR REPLACE INTO tenant_quotas ({self._COLS}) "
+                "VALUES (?,?,?,?,?,?)",
+                (quota.appid, quota.rate, quota.burst, quota.concurrency,
+                 quota.queue_max, quota.weight))
+
+    def get(self, appid: int) -> Optional[TenantQuota]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                f"SELECT {self._COLS} FROM tenant_quotas WHERE appid=?",
+                (appid,)).fetchone()
+        return TenantQuota(*row) if row else None
+
+    def get_all(self) -> List[TenantQuota]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self._COLS} FROM tenant_quotas "
+                "ORDER BY appid").fetchall()
+        return [TenantQuota(*r) for r in rows]
+
+    def delete(self, appid: int) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "DELETE FROM tenant_quotas WHERE appid=?", (appid,))
 
 
 class SQLiteLeases(base.Leases):

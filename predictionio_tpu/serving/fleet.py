@@ -85,7 +85,7 @@ _log = get_logger("serving.fleet")
 # headers forwarded verbatim to the replica (deadline propagation,
 # request-id correlation, auth)
 _FORWARD_HEADERS = ("X-PIO-Deadline-Ms", "X-Request-ID", "Authorization",
-                    "Content-Type")
+                    "Content-Type", "X-PIO-App")
 
 # reserved model-store id for the membership snapshot (per variant);
 # fsck's divergence sweep reports but never deletes unknown ids, so the
@@ -221,6 +221,18 @@ class FleetServer(HTTPServerBase):
                 "members, or --standby contends for the lease)")
         self.ctx = RuntimeContext(registry=registry)
         self.auth = KeyAuthentication(config.server_key or None)
+        # multi-tenant admission: the ROUTER is the auth + quota
+        # boundary of a fleet — it authenticates the app key and
+        # charges rate/concurrency ONCE, then asserts the identity to
+        # replicas via X-PIO-App (replicas run trust_header variants
+        # and only re-apply per-tenant FAIRNESS, never a second charge)
+        from predictionio_tpu.tenancy import (
+            AdmissionController, TenancyConfig,
+        )
+        tcfg = (config.tenancy if config.tenancy is not None
+                else TenancyConfig.from_env())
+        self.admission = AdmissionController(
+            tcfg, registry=self.ctx.registry, metrics=self.metrics)
         self._engine_arg = engine
         self._plugins = plugins
         self._rr_lock = threading.Lock()
@@ -267,9 +279,17 @@ class FleetServer(HTTPServerBase):
         if self.config.refresh_interval_s > 0 and self.fleet.replicas > 1:
             stagger = (index * self.config.refresh_interval_s
                        / self.fleet.replicas)
+        # the router already authenticated and charged the quota;
+        # replicas trust its X-PIO-App assertion and apply only the
+        # weighted-fair batching layer (admission is absent only on
+        # partially constructed servers in tests)
+        admission = getattr(self, "admission", None)
+        tenancy = (admission.config.replica_variant()
+                   if admission is not None else None)
         return dataclasses.replace(
             self.config, ip="127.0.0.1", port=0, startup_check=False,
-            max_inflight=0, refresh_stagger_s=stagger)
+            max_inflight=0, refresh_stagger_s=stagger,
+            tenancy=tenancy)
 
     def start(self, background: bool = True) -> int:
         for i in range(self.fleet.replicas):
@@ -669,12 +689,14 @@ class FleetServer(HTTPServerBase):
             self._rr_next += 1
         return admitted[start:] + admitted[:start]
 
-    def _proxy(self, rep: _Replica, req: Request, timeout: float
+    def _proxy(self, rep: _Replica, req: Request, timeout: float,
+               extra_headers: Optional[Dict[str, str]] = None
                ) -> Response:
         """Forward one request to one member. An HTTP error status is
         a RESPONSE (the member is alive and answered — pass it
         through); only transport-level failures raise OSError to the
-        retry loop."""
+        retry loop. `extra_headers` are router-asserted values (the
+        authenticated tenant identity) layered over the forwarded set."""
         if faults().dropped(f"fleet.net.{rep.key}.data"):
             raise OSError(f"injected partition: fleet.net.{rep.key}.data")
         url = f"http://{rep.host}:{rep.port}{req.path}"
@@ -683,6 +705,8 @@ class FleetServer(HTTPServerBase):
             v = req.header(name)
             if v:
                 headers[name] = v
+        if extra_headers:
+            headers.update(extra_headers)
         proxied = urllib.request.Request(
             url, data=req.body if req.method == "POST" else None,
             method=req.method, headers=headers)
@@ -699,7 +723,8 @@ class FleetServer(HTTPServerBase):
                 content_type=e.headers.get(
                     "Content-Type", "application/json"))
 
-    def _route(self, req: Request) -> Response:
+    def _route(self, req: Request,
+               extra_headers: Optional[Dict[str, str]] = None) -> Response:
         """Route to an admitted member; connection-level failures are
         retried on the NEXT admitted member (zero failed client
         requests when a member dies), each failure feeding the
@@ -727,7 +752,8 @@ class FleetServer(HTTPServerBase):
                 if remaining <= 0.005:
                     # the budget is spent: shed with 504 BEFORE dialing
                     # rather than burning a connection on a doomed call
-                    self._shed_counter.labels(surface="deadline").inc()
+                    self._shed_counter.labels(surface="deadline",
+                                              app="").inc()
                     raise DeadlineExceeded(
                         "deadline budget exhausted before dialing a "
                         "replica")
@@ -735,7 +761,7 @@ class FleetServer(HTTPServerBase):
             with rep.lock:
                 rep.inflight += 1
             try:
-                resp = self._proxy(rep, req, timeout)
+                resp = self._proxy(rep, req, timeout, extra_headers)
             except OSError as e:
                 last_err = e
                 self._record_failure(
@@ -916,7 +942,12 @@ class FleetServer(HTTPServerBase):
 
         @r.post("/queries.json")
         def queries(req: Request) -> Response:
-            return self._route(req)
+            from predictionio_tpu.tenancy import TENANT_HEADER
+            tenant = self.admission.resolve(req)
+            with self.admission.admit(tenant):
+                extra = ({TENANT_HEADER: tenant.header_value()}
+                         if tenant is not None else None)
+                return self._route(req, extra_headers=extra)
 
         @r.post("/fleet/register")
         def fleet_register(req: Request) -> Response:

@@ -47,6 +47,10 @@ from predictionio_tpu.resilience import (
 from predictionio_tpu.serving.plugins import (
     EngineServerPluginContext, QueryInfo,
 )
+from predictionio_tpu.tenancy import (
+    DEFAULT_TENANT, AdmissionController, DRRQueue, TenancyConfig,
+    TenantIdentity,
+)
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
 )
@@ -107,9 +111,15 @@ class _ServeInstruments:
             "pio_feedback_dropped_total",
             "Feedback events dropped (queue full / send retries "
             "exhausted)", labels=("reason",))
+        # the `app` label is the shedding tenant ("" on surfaces with no
+        # tenant attribution — HTTP-plane inflight, fleet pre-dial)
         self.shed = metrics.counter(
             "pio_shed_total", "Requests shed by surface at admission",
-            labels=("surface",))
+            labels=("surface", "app"))
+        self.tenant_serve = metrics.histogram(
+            "pio_tenant_serve_seconds",
+            "End-to-end serve latency per authenticated app",
+            labels=("app",))
         self.algo_errors = metrics.counter(
             "pio_algo_errors_total",
             "Per-algorithm predict failures isolated by graceful "
@@ -176,6 +186,11 @@ class ServerConfig:
     # set per replica by FleetServer so at most one replica of a fleet
     # is folding at any instant
     refresh_stagger_s: float = 0.0
+    # multi-tenant admission (tenancy/): None = read the PIO_TENANCY /
+    # PIO_TENANT_* env knobs (default off — the serve path then runs
+    # the exact pre-tenancy code shape). FleetServer hands replicas a
+    # trust-header variant of the leader's config.
+    tenancy: Optional[TenancyConfig] = None
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -295,7 +310,28 @@ class _MicroBatcher:
     being queued to die into a 504 — the queue-delay signal reacts to
     slow drains long before the static queue_max cap fills. The EWMA
     only sheds while work is actually pending, so it self-corrects:
-    admitted traffic keeps draining and decays a stale spike."""
+    admitted traffic keeps draining and decays a stale spike.
+
+    Multi-tenancy: the pending store is a DRR queue of per-tenant lanes
+    (tenancy/drr.py). Each lane is bounded by the tenant's own
+    `queue_max` quota, so one aggressor saturates its lane, not the
+    global cap; the drainer composes batches weighted-fair across
+    lanes; and the adaptive shed above runs on the SUBMITTING TENANT's
+    lane EWMA — the tenant causing the backlog is the one whose items
+    wait, so it sheds first while well-behaved tenants keep admitting.
+    With tenancy off every item lands in the single default lane and
+    all of this reduces exactly to the legacy FIFO behavior.
+
+    Deadline-aware admission: a submit whose deadline cannot survive
+    one batching window plus the observed drain time (EWMA of
+    `_process` wall time) is shed 504 at the door — no point occupying
+    a batch slot with work that expires before its batch returns
+    (pio_shed_total{surface=deadline_batch}).
+
+    The batcher also keeps a pow2 histogram of the batch sizes it
+    actually formed (`size_counts`); the server persists it beside the
+    dispatch-policy snapshot and the next warm_deploy pre-compiles
+    exactly the observed shapes instead of the full pow2 ladder."""
 
     # EWMA smoothing for the observed enqueue->drain latency
     DELAY_ALPHA = 0.2
@@ -314,46 +350,106 @@ class _MicroBatcher:
         # out the rest of the window; also signals close() waiters on
         # retire (predicate re-checked, spurious wakeups harmless)
         self._full = threading.Condition(self._lock)
-        # each item: (deployment, query, done event, result slot,
-        #             enqueue perf_counter)
-        self._pending: List[tuple] = []
+        # per-tenant DRR lanes; each item: (deployment, query, done
+        # event, result slot, enqueue perf_counter, tenant label)
+        self._queue = DRRQueue()
         self._draining = False
         self._closed = False
         self._delay_ewma = 0.0
+        # EWMA of _process wall time — the deadline_batch admission
+        # check's estimate of "how long until a batch admitted now
+        # actually returns"
+        self._drain_ewma = 0.0
+        # observed pow2 batch-size counts (≤ log2(batch_max) keys, so
+        # bounded by construction); feeds warm_deploy bucket autotune
+        self._size_counts: Dict[int, int] = {}
 
     def queue_delay_ewma(self) -> float:
         """Current smoothed enqueue->drain latency estimate (seconds)."""
         with self._lock:
             return self._delay_ewma
 
+    def drain_time_ewma(self) -> float:
+        """Smoothed batch-processing wall time (seconds)."""
+        with self._lock:
+            return self._drain_ewma
+
+    def size_counts(self) -> Dict[int, int]:
+        """Observed batch sizes, rounded up to pow2 -> drain count."""
+        with self._lock:
+            return dict(self._size_counts)
+
+    def restore_size_counts(self, counts: Dict[int, int]) -> None:
+        """Seed the size histogram from a persisted snapshot."""
+        with self._lock:
+            for k, v in counts.items():
+                try:
+                    k, v = int(k), int(v)  # lint: ok (JSON host values)
+                except (TypeError, ValueError):
+                    continue
+                # pow2 keys only: bounded at log2(batch_max) entries
+                self._size_counts[k] = self._size_counts.get(k, 0) + v
+
+    def tenant_depth(self, tenant: str) -> int:
+        with self._lock:
+            return self._queue.depth(tenant)
+
     def submit(self, deployment: _Deployment, query: Any,
-               deadline: Optional[Deadline] = None) -> Any:
+               deadline: Optional[Deadline] = None,
+               tenant: str = DEFAULT_TENANT, weight: float = 1.0,
+               tenant_queue_max: int = 0) -> Any:
         done = threading.Event()
         slot: Dict[str, Any] = {}
-        item = (deployment, query, done, slot, time.perf_counter())
+        item = (deployment, query, done, slot, time.perf_counter(), tenant)
         with self._lock:
             if self._closed:
-                self.obs.shed.labels(surface="queries").inc()
+                self.obs.shed.labels(surface="queries", app=tenant).inc()
                 raise OverloadedError(
                     "server draining for shutdown", retry_after=1.0)
-            if self.queue_max > 0 and len(self._pending) >= self.queue_max:
-                self.obs.shed.labels(surface="queries").inc()
+            if self.queue_max > 0 and len(self._queue) >= self.queue_max:
+                self.obs.shed.labels(surface="queries", app=tenant).inc()
                 raise OverloadedError(
                     "micro-batch queue full",
                     retry_after=max(self.window_s, 0.05))
-            # adaptive shed: don't queue work predicted to expire there
             budget = self.submit_timeout_s
             if deadline is not None:
                 budget = min(budget, max(deadline.remaining(), 0.0))
-            if self._pending and self._delay_ewma > budget:
-                self.obs.shed.labels(surface="queue_delay").inc()
+                # deadline-aware admission: even an EMPTY queue costs
+                # one window + one drain; a budget below that dies in
+                # the batch, so shed it 504 now and keep the slot for
+                # work that can finish
+                if self._drain_ewma > 0.0 and \
+                        budget < self.window_s + self._drain_ewma:
+                    self.obs.shed.labels(surface="deadline_batch",
+                                         app=tenant).inc()
+                    raise DeadlineExceeded(
+                        f"deadline budget {budget * 1e3:.0f}ms below "
+                        f"batch window + drain estimate "
+                        f"{(self.window_s + self._drain_ewma) * 1e3:.0f}ms")
+            # adaptive shed: don't queue work predicted to expire
+            # there. Tenanted submits judge their OWN lane's delay
+            # EWMA — the tenant whose backlog grows is the one shed —
+            # while the default lane keeps the global estimate
+            ewma = (self._delay_ewma if tenant == DEFAULT_TENANT
+                    else self._queue.delay_ewma(tenant))
+            if len(self._queue) and ewma > budget:
+                self.obs.shed.labels(surface="queue_delay",
+                                     app=tenant).inc()
                 raise OverloadedError(
-                    f"predicted queue delay {self._delay_ewma * 1e3:.0f}ms"
+                    f"predicted queue delay {ewma * 1e3:.0f}ms"
                     f" exceeds request budget {budget * 1e3:.0f}ms",
-                    retry_after=self._delay_ewma)
-            self._pending.append(item)
-            self.obs.queue_depth.set(float(len(self._pending)))
-            if len(self._pending) >= self.batch_max:
+                    retry_after=ewma)
+            if not self._queue.push(tenant, item, weight=weight,
+                                    queue_max=tenant_queue_max):
+                # the tenant's own lane is at ITS cap — shed just this
+                # tenant; other lanes (and the global cap) are untouched
+                self.obs.shed.labels(surface="queries", app=tenant).inc()
+                raise OverloadedError(
+                    f"per-tenant micro-batch queue full "
+                    f"({tenant_queue_max} pending)",
+                    retry_after=max(self.window_s, 0.05))
+            self.obs.queue_depth.set(float(len(self._queue)))
+            if len(self._queue) >= self.batch_max:
                 self._full.notify()
             drain = not self._draining
             if drain:
@@ -367,11 +463,8 @@ class _MicroBatcher:
             # expired while queued (or the drainer is wedged): withdraw
             # the item if it hasn't been taken yet, then report 504
             with self._lock:
-                try:
-                    self._pending.remove(item)
-                    self.obs.queue_depth.set(float(len(self._pending)))
-                except ValueError:
-                    pass  # already drained; result will be discarded
+                if self._queue.remove(tenant, item):
+                    self.obs.queue_depth.set(float(len(self._queue)))
             raise DeadlineExceeded(
                 "request deadline expired in micro-batch queue"
                 if deadline is not None else
@@ -389,11 +482,10 @@ class _MicroBatcher:
                     # wait out the window — but a full batch forming
                     # mid-window notifies the condition and ships NOW
                     self._full.wait_for(
-                        lambda: len(self._pending) >= self.batch_max,
+                        lambda: len(self._queue) >= self.batch_max,
                         timeout=self.window_s)
-                    batch = self._pending[:self.batch_max]
-                    self._pending = self._pending[self.batch_max:]
-                    self.obs.queue_depth.set(float(len(self._pending)))
+                    batch = self._queue.take(self.batch_max)
+                    self.obs.queue_depth.set(float(len(self._queue)))
                     if not batch:
                         # nothing arrived during the window: retire. The
                         # flag is cleared under the same lock any submit
@@ -403,12 +495,18 @@ class _MicroBatcher:
                         self._full.notify_all()
                         return
                     now = time.perf_counter()
-                    for _, _, _, _, t_enq in batch:
+                    for _, _, _, _, t_enq, tenant in batch:
                         delay = max(now - t_enq, 0.0)
                         self.obs.queue_delay.observe(delay)
                         self._delay_ewma += self.DELAY_ALPHA * (
                             delay - self._delay_ewma)
+                        self._queue.observe_delay(tenant, delay)
+                t0 = time.perf_counter()
                 self._process(batch)
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self._drain_ewma += self.DELAY_ALPHA * (
+                        dt - self._drain_ewma)
                 batch = []
         except BaseException as e:
             # drainer crash: fail every waiter NOW — the dequeued batch
@@ -416,12 +514,11 @@ class _MicroBatcher:
             # their timeouts, and clear the flag so the next submit
             # spawns a healthy drainer
             with self._lock:
-                stranded = batch + self._pending
-                self._pending = []
+                stranded = batch + self._queue.drain_all()
                 self._draining = False
                 self._full.notify_all()
                 self.obs.queue_depth.set(0.0)
-            for _, _, done, slot, _ in stranded:
+            for _, _, done, slot, _, _ in stranded:
                 slot["error"] = e
                 done.set()
             _log.error("batch_drainer_crashed",
@@ -436,7 +533,7 @@ class _MicroBatcher:
         with self._lock:
             self._closed = True
             return self._full.wait_for(
-                lambda: not self._pending and not self._draining,
+                lambda: not len(self._queue) and not self._draining,
                 timeout=timeout)
 
     def reopen(self) -> None:
@@ -447,21 +544,27 @@ class _MicroBatcher:
     def _process(self, pending: List[tuple]) -> None:
         if not pending:
             return
-        self.obs.batch_size.observe(float(len(pending)))
+        n = len(pending)
+        self.obs.batch_size.observe(float(n))  # lint: ok (host int)
+        pow2 = 1
+        while pow2 < n:
+            pow2 <<= 1
+        with self._lock:
+            self._size_counts[pow2] = self._size_counts.get(pow2, 0) + 1
         # group by deployment (reload may swap mid-flight)
         by_dep: Dict[int, List] = {}
         for item in pending:
             by_dep.setdefault(id(item[0]), []).append(item)
         for items in by_dep.values():
             dep = items[0][0]
-            queries = [q for _, q, _, _, _ in items]
+            queries = [item[1] for item in items]
             try:
                 results = dep.predict_batch(queries)
-                for (_, _, done, slot, _), r in zip(items, results):
+                for (_, _, done, slot, _, _), r in zip(items, results):
                     slot["result"] = r
                     done.set()
             except Exception as e:
-                for _, _, done, slot, _ in items:
+                for _, _, done, slot, _, _ in items:
                     slot["error"] = e
                     done.set()
 
@@ -488,6 +591,12 @@ class PredictionServer(HTTPServerBase):
         self.ctx = RuntimeContext(registry=registry, workflow_params=wp)
         self.plugin_context = EngineServerPluginContext(plugins)
         self.auth = KeyAuthentication(config.server_key or None)
+        # per-app auth + quotas on /queries.json; off by default so a
+        # bare deploy keeps the open serve path
+        tcfg = (config.tenancy if config.tenancy is not None
+                else TenancyConfig.from_env())
+        self.admission = AdmissionController(
+            tcfg, registry=self.ctx.registry, metrics=self.metrics)
         self._engine_arg = engine
         self._dep: Optional[_Deployment] = None
         self._dep_lock = threading.Lock()
@@ -572,12 +681,18 @@ class PredictionServer(HTTPServerBase):
                       else resolve_engine(self.config.engine_factory))
             if instance is None:
                 instance = self._resolve_instance()
-            # warm the pow2 buckets the micro-batcher can actually form;
-            # without batching only the single-query shape matters
+            # warm the pow2 buckets the micro-batcher can actually
+            # form; when a previous run recorded which batch sizes real
+            # traffic produced, warm exactly THOSE shapes instead of
+            # the whole ladder. Without batching only the single-query
+            # shape matters.
+            observed = (self._batcher.size_counts()
+                        if self._batcher is not None else None)
             algos, models, serving = CoreWorkflow.prepare_deploy(
                 engine, instance, self.ctx,
                 warm_batch_max=(self.config.batch_max
-                                if self._batcher is not None else 1))
+                                if self._batcher is not None else 1),
+                observed_sizes=observed or None)
         except Exception:
             self._serve_obs.reloads.labels(outcome="failed").inc()
             raise
@@ -615,6 +730,15 @@ class PredictionServer(HTTPServerBase):
             return Path(p).expanduser()
         return Path("~/.pio_store/serving/dispatch_policy.json").expanduser()
 
+    @classmethod
+    def _batch_sizes_path(cls):
+        """The observed batch-size histogram lives beside the dispatch
+        snapshot (same PIO_DISPATCH_STATE off/override semantics)."""
+        path = cls._dispatch_state_path()
+        if path is None:
+            return None
+        return path.with_name("batch_sizes.json")
+
     def _restore_dispatch_state(self) -> None:
         path = self._dispatch_state_path()
         if path is None:
@@ -623,9 +747,20 @@ class PredictionServer(HTTPServerBase):
         try:
             state = json.loads(path.read_text())
         except (OSError, ValueError):
-            return                       # absent/corrupt: cold start
+            state = None                 # absent/corrupt: cold start
         if isinstance(state, dict):
             DISPATCH_POLICY.restore(state)
+        # the previous run's observed batch sizes seed both this run's
+        # histogram and the warm_deploy bucket derivation in _load
+        if self._batcher is None:
+            return
+        sizes_path = self._batch_sizes_path()
+        try:
+            sizes = json.loads(sizes_path.read_text())
+        except (OSError, ValueError):
+            return
+        if isinstance(sizes, dict):
+            self._batcher.restore_size_counts(sizes)
 
     def _save_dispatch_state(self) -> None:
         path = self._dispatch_state_path()
@@ -636,6 +771,13 @@ class PredictionServer(HTTPServerBase):
         try:
             path.parent.mkdir(parents=True, exist_ok=True)
             atomic_write_text(path, json.dumps(DISPATCH_POLICY.snapshot()))
+            if self._batcher is not None:
+                counts = self._batcher.size_counts()
+                if counts:
+                    atomic_write_text(
+                        self._batch_sizes_path(),
+                        json.dumps({str(k): v
+                                    for k, v in sorted(counts.items())}))
         except OSError:
             pass                         # persistence is best-effort
 
@@ -717,6 +859,10 @@ class PredictionServer(HTTPServerBase):
         self._flush_feedback(max(budget - (time.perf_counter() - t0), 0.0))
         if self._fsck_sched is not None:
             self._fsck_sched.stop()
+        # checkpoint the dispatch EWMAs AND the batch-size histogram
+        # accumulated while serving, so the next start's warm_deploy
+        # pre-compiles the shapes this run actually saw
+        self._save_dispatch_state()
         self.shutdown()
 
     def _flush_feedback(self, timeout_s: float) -> None:
@@ -734,7 +880,8 @@ class PredictionServer(HTTPServerBase):
                          remaining=self._feedback_queue.unfinished_tasks)
 
     # -- serving -------------------------------------------------------------
-    def _serve_one(self, query_json: Any) -> Any:
+    def _serve_one(self, query_json: Any,
+                   tenant: Optional[TenantIdentity] = None) -> Any:
         t0 = time.perf_counter()
         dep = self._dep
         with self._serve_obs.stage.labels(stage="extract").time():
@@ -743,8 +890,11 @@ class PredictionServer(HTTPServerBase):
             else:
                 query = query_json
         if self._batcher is not None:
+            label, weight, tqmax = self.admission.batch_params(tenant)
             prediction = self._batcher.submit(dep, query,
-                                              deadline=current_deadline())
+                                              deadline=current_deadline(),
+                                              tenant=label, weight=weight,
+                                              tenant_queue_max=tqmax)
         else:
             prediction = dep.predict_batch([query])[0]
         # feedback loop + prId injection (CreateServer.scala:506-576)
@@ -760,6 +910,8 @@ class PredictionServer(HTTPServerBase):
         self.plugin_context.notify_sniffers(
             QueryInfo(dep.instance.engine_variant, query, prediction))
         dt = time.perf_counter() - t0
+        if tenant is not None:
+            self._serve_obs.tenant_serve.labels(app=tenant.label).observe(dt)
         with self._stats_lock:
             self.request_count += 1
             self.last_serving_sec = dt
@@ -839,11 +991,18 @@ class PredictionServer(HTTPServerBase):
 
         @r.post("/queries.json")
         def queries(req: Request) -> Response:
-            try:
-                payload = req.json()
-            except ValueError as e:
-                raise HTTPError(400, str(e))
-            return Response.json(self._serve_one(payload))
+            # with tenancy on, this is the same contract the event
+            # server enforces on ingest: authenticate the app key, then
+            # charge the app's rate/concurrency quota (429 + Retry-After
+            # over quota); tenancy off -> tenant is None, open serve
+            tenant = self.admission.resolve(req)
+            with self.admission.admit(tenant):
+                try:
+                    payload = req.json()
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                return Response.json(self._serve_one(payload,
+                                                     tenant=tenant))
 
         @r.get("/")
         def index(req: Request) -> Response:

@@ -1,0 +1,21 @@
+"""Multi-tenant admission control for the serving tier.
+
+The reference system authenticates every EVENT with per-app access
+keys but serves predictions wide open; this package brings the serve
+path up to the same multi-app standard: per-app auth reusing the event
+server's `AccessKeys` DAO, token-bucket + concurrency quotas with
+metadata-store overrides, and a weighted-fair (deficit round robin)
+micro-batch queue so one tenant's overload cannot starve the rest.
+
+  admission.py  TenancyConfig, AdmissionController, TenantIdentity
+  drr.py        DRRQueue — the batcher's weighted-fair pending queue
+
+Disabled by default (`PIO_TENANCY=off`): the serve path then runs the
+exact pre-tenancy code shape (single FIFO lane, no auth, no charges).
+"""
+
+from predictionio_tpu.tenancy.admission import (  # noqa: F401
+    DEFAULT_TENANT, TENANT_HEADER, AdmissionController, BoundedTenantMap,
+    TenancyConfig, TenantIdentity,
+)
+from predictionio_tpu.tenancy.drr import DRRQueue  # noqa: F401

@@ -1,0 +1,409 @@
+"""Per-app admission control for the serve path.
+
+The reference system is multi-app end to end on INGEST — access keys
+and channels gate every event (EventServer.scala:92-130) — but its
+prediction servers are single-tenant. This module closes that gap for
+serving: queries authenticate with the SAME app access keys the event
+server validates (reusing the `AccessKeys`/`Apps` DAOs), and every
+admitted request carries a tenant identity the micro-batcher uses for
+weighted-fair scheduling.
+
+Three admission layers, all per tenant:
+
+  - token-bucket RATE limit (`rate` req/s refill, `burst` capacity):
+    sustained overload sheds with 429 + Retry-After at the bucket's
+    next-token estimate, counted in `pio_shed_total{surface=quota,app=}`
+  - CONCURRENCY quota (`concurrency` in flight, 0 = unlimited): bursts
+    that outrun the device shed the same way
+  - the micro-batcher's per-tenant QUEUE bound + DRR drain (drr.py) —
+    enforced downstream, parameterized from the same quota row
+
+Defaults come from env/CLI (`PIO_TENANCY`, `PIO_TENANT_RATE`,
+`PIO_TENANT_BURST`, `PIO_TENANT_QUEUE_MAX`, `PIO_TENANT_CONCURRENCY`);
+per-app overrides live in the metadata store (`TenantQuotas` DAO) and
+are picked up within `overrides_ttl_s` — no redeploy to retune one app.
+
+Fleet trust model: the leader authenticates and charges quotas ONCE,
+then forwards identity to replicas in the `X-PIO-App` header. Replicas
+run with `trust_header=True` and skip re-auth/re-charge (fairness still
+applies per replica). The header is only honored when trust_header is
+set — a standalone server ignores it — and the fleet tier is assumed to
+sit on a private network (see the fleet transport note in README).
+
+All per-tenant state is bounded: tenant maps are LRU-capped at
+`max_tenants` (the lint gate in tools/lint.py enforces this property
+for any tenant-keyed container in tenancy/ + serving/).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field, replace
+from typing import Mapping, Optional, Tuple
+
+from predictionio_tpu.data.storage.base import TenantQuota
+from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
+from predictionio_tpu.resilience import OverloadedError
+from predictionio_tpu.utils.http import HTTPError, Request, \
+    parse_basic_auth_user
+
+TENANT_HEADER = "X-PIO-App"
+# the label every request gets when tenancy is off (or a trusted-header
+# replica receives direct traffic): one shared FIFO lane, zero tenant
+# bookkeeping — the PIO_TENANCY=off serve path stays unchanged
+DEFAULT_TENANT = ""
+
+_log = get_logger("tenancy")
+
+
+@dataclass(frozen=True)
+class TenantIdentity:
+    """An authenticated app on the serve path."""
+    app_id: int
+    label: str                   # metrics `app` label (the app name)
+    # identity arrived via the trusted fleet header: the leader already
+    # charged this request's quota; do not charge it again here
+    pre_admitted: bool = False
+
+    def header_value(self) -> str:
+        return f"{self.app_id}:{self.label}"
+
+
+@dataclass
+class TenancyConfig:
+    """Admission-control knobs (env: PIO_TENANCY, PIO_TENANT_*)."""
+    enabled: bool = False
+    rate: float = 100.0          # default per-app token refill, req/s
+    burst: float = 200.0         # default bucket capacity
+    concurrency: int = 0         # default in-flight cap (0 = unlimited)
+    queue_max: int = 64          # default per-tenant micro-batch pending cap
+    weight: float = 1.0          # default DRR weight
+    # bound on per-tenant state (buckets, inflight counters, subqueues)
+    max_tenants: int = 1024
+    # accept X-PIO-App from the fleet tier instead of re-authenticating
+    # (set on fleet replicas only; implies the leader charged the quota)
+    trust_header: bool = False
+    # how stale a cached per-app override may get before re-reading the
+    # metadata store
+    overrides_ttl_s: float = 10.0
+
+    @staticmethod
+    def from_env(cfg: Optional[Mapping[str, str]] = None,
+                 **overrides) -> "TenancyConfig":
+        """Build from environment-style config (the CLI passes the
+        registry's layered config); explicit `overrides` win."""
+        import os
+        cfg = cfg if cfg is not None else os.environ
+        kw: dict = {}
+        mode = str(cfg.get("PIO_TENANCY", "") or "").strip().lower()
+        if mode:
+            kw["enabled"] = mode in ("on", "1", "true", "yes")
+        try:
+            for env, field_name, cast in (
+                    ("PIO_TENANT_RATE", "rate", float),
+                    ("PIO_TENANT_BURST", "burst", float),
+                    ("PIO_TENANT_CONCURRENCY", "concurrency", int),
+                    ("PIO_TENANT_QUEUE_MAX", "queue_max", int),
+                    ("PIO_TENANT_MAX", "max_tenants", int)):
+                raw = cfg.get(env)
+                if raw:
+                    kw[field_name] = cast(raw)
+        except ValueError as e:
+            raise ValueError(f"bad PIO_TENANT_* value: {e}") from e
+        kw.update(overrides)
+        return TenancyConfig(**kw)
+
+    def default_quota(self) -> TenantQuota:
+        return TenantQuota(appid=0, rate=self.rate, burst=self.burst,
+                           concurrency=self.concurrency,
+                           queue_max=self.queue_max, weight=self.weight)
+
+    def replica_variant(self) -> "TenancyConfig":
+        """The config a fleet replica runs: identity from the leader's
+        header, quotas already charged upstream, fairness kept."""
+        return replace(self, trust_header=True)
+
+
+class _TokenBucket:
+    """Lazy-refill token bucket on the monotonic clock; caller-locked."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = max(rate, 0.0)
+        self.burst = max(burst, 1.0)
+        self.tokens = self.burst
+        self.t_last = time.monotonic()
+
+    def try_take(self) -> float:
+        """0.0 when a token was taken; else seconds until one accrues."""
+        now = time.monotonic()
+        if self.rate > 0:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        if self.rate <= 0:
+            return 1.0               # rate 0 = fully blocked tenant
+        return (1.0 - self.tokens) / self.rate
+
+
+@dataclass
+class _TenantState:
+    """Everything admission tracks for one tenant."""
+    quota: TenantQuota
+    bucket: _TokenBucket
+    inflight: int = 0
+    quota_loaded: float = field(default_factory=time.monotonic)
+
+
+class BoundedTenantMap:
+    """LRU-bounded mapping for tenant-keyed state — the only sanctioned
+    container shape for per-tenant growth (tools/lint.py gates any
+    other tenant map in tenancy/ + serving/). Eviction drops the
+    least-recently-USED entry, so a scan of throwaway tenants cannot
+    displace the active set faster than it refreshes itself."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+        self._entries: "OrderedDict[str, object]" = OrderedDict()
+
+    def get(self, key: str):
+        v = self._entries.get(key)
+        if v is not None:
+            self._entries.move_to_end(key)
+        return v
+
+    def put(self, key: str, value) -> None:
+        self._entries[key] = value
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.cap:
+            self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+
+class AdmissionController:
+    """Authenticates `/queries.json` and enforces per-tenant quotas.
+
+    Lifecycle: one per PredictionServer/FleetServer. `resolve()` turns a
+    request into a `TenantIdentity` (or None when tenancy is off);
+    `admit(tenant)` is a context manager charging the token bucket and
+    concurrency quota around the serve call."""
+
+    def __init__(self, config: TenancyConfig, registry=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.config = config
+        self.registry = registry
+        metrics = metrics if metrics is not None else get_registry()
+        self._lock = threading.Lock()
+        self._tenants = BoundedTenantMap(config.max_tenants)
+        # access key -> TenantIdentity (positive entries only: a miss
+        # costs one DAO read, a bounded price for not caching garbage)
+        self._keys = BoundedTenantMap(config.max_tenants)
+        self._shed = metrics.counter(
+            "pio_shed_total", "Requests shed by surface at admission",
+            labels=("surface", "app"))
+        self._admitted = metrics.counter(
+            "pio_tenant_admitted_total",
+            "Requests admitted through per-tenant quota checks",
+            labels=("app",))
+        self._tenant_gauge = metrics.gauge(
+            "pio_tenant_active", "Tenants with live admission state")
+        self._quota_dao = None
+        self._quota_dao_failed = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    # -- authentication ------------------------------------------------------
+    def resolve(self, req: Request) -> Optional[TenantIdentity]:
+        """Authenticate one request. None when tenancy is disabled.
+        Raises HTTPError(401) on missing/invalid credentials."""
+        if not self.config.enabled:
+            return None
+        if self.config.trust_header:
+            hv = req.header(TENANT_HEADER)
+            if hv:
+                ident = self._parse_header(hv)
+                if ident is not None:
+                    return ident
+            # direct traffic to a trusted-header replica (tests, ops
+            # probes) falls through to normal key auth
+        key = req.query_get("accessKey")
+        if key is None:
+            key = parse_basic_auth_user(req.headers)
+            if key is None:
+                raise HTTPError(401, "Missing accessKey.")
+        with self._lock:
+            cached = self._keys.get(key)
+        if cached is not None:
+            return cached
+        ak = self._access_keys().get(key)
+        if ak is None:
+            raise HTTPError(401, "Invalid accessKey.")
+        label = self._app_label(ak.appid)
+        ident = TenantIdentity(app_id=ak.appid, label=label)
+        with self._lock:
+            self._keys.put(key, ident)
+        return ident
+
+    @staticmethod
+    def _parse_header(value: str) -> Optional[TenantIdentity]:
+        appid, sep, label = value.partition(":")
+        if not sep or not label:
+            return None
+        try:
+            app_id = int(appid)
+        except ValueError:
+            return None
+        return TenantIdentity(app_id=app_id, label=label,
+                              pre_admitted=True)
+
+    def _access_keys(self):
+        if self.registry is None:
+            raise HTTPError(503, "tenancy enabled but no metadata store")
+        return self.registry.get_meta_data_access_keys()
+
+    def _app_label(self, app_id: int) -> str:
+        try:
+            app = self.registry.get_meta_data_apps().get(app_id)
+            if app is not None and app.name:
+                return app.name
+        except Exception:
+            pass
+        return f"app-{app_id}"
+
+    # -- quota resolution ----------------------------------------------------
+    def _quotas_dao(self):
+        """The overrides DAO, or None when the store has none (warned
+        once; defaults apply)."""
+        if self._quota_dao is None and not self._quota_dao_failed \
+                and self.registry is not None:
+            try:
+                self._quota_dao = \
+                    self.registry.get_meta_data_tenant_quotas()
+            except Exception as e:
+                self._quota_dao_failed = True
+                _log.warning("tenant_quota_dao_unavailable",
+                             error=f"{type(e).__name__}: {e}",
+                             fallback="env/CLI defaults")
+        return self._quota_dao
+
+    def _load_quota(self, tenant: TenantIdentity) -> TenantQuota:
+        default = self.config.default_quota()
+        dao = self._quotas_dao()
+        if dao is None:
+            return default
+        try:
+            row = dao.get(tenant.app_id)
+        except Exception as e:
+            _log.warning("tenant_quota_read_failed", app=tenant.label,
+                         error=f"{type(e).__name__}: {e}")
+            return default
+        if row is None:
+            return default
+        return row.merged_over(default)
+
+    def _state(self, tenant: TenantIdentity) -> _TenantState:
+        """The tenant's admission state, created or TTL-refreshed under
+        the controller lock."""
+        st = self._tenants.get(tenant.label)
+        if st is None:
+            quota = self._load_quota(tenant)
+            st = _TenantState(
+                quota=quota,
+                bucket=_TokenBucket(quota.rate, quota.burst))
+            self._tenants.put(tenant.label, st)
+            self._tenant_gauge.set(float(len(self._tenants)))
+        elif (time.monotonic() - st.quota_loaded
+                > self.config.overrides_ttl_s):
+            quota = self._load_quota(tenant)
+            if quota != st.quota:
+                st.bucket.rate = max(quota.rate or 0.0, 0.0)
+                st.bucket.burst = max(quota.burst or 1.0, 1.0)
+            st.quota = quota
+            st.quota_loaded = time.monotonic()
+        return st
+
+    def quota(self, tenant: TenantIdentity) -> TenantQuota:
+        """The tenant's effective quota (defaults merged with any
+        stored override), from the TTL cache."""
+        with self._lock:
+            return self._state(tenant).quota
+
+    def batch_params(self, tenant: Optional[TenantIdentity]
+                     ) -> Tuple[str, float, int]:
+        """(label, DRR weight, per-tenant queue cap) for the
+        micro-batcher submit."""
+        if tenant is None or not self.config.enabled:
+            return DEFAULT_TENANT, 1.0, 0
+        with self._lock:
+            q = self._state(tenant).quota
+        return (tenant.label, q.weight or 1.0,
+                int(q.queue_max or self.config.queue_max))
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, tenant: Optional[TenantIdentity]) -> "_AdmitGuard":
+        """Charge the tenant's rate + concurrency quotas; raises
+        OverloadedError(429) on either limit. Pre-admitted identities
+        (trusted fleet header: the leader already charged them) and
+        disabled tenancy pass through untouched."""
+        if tenant is None or tenant.pre_admitted \
+                or not self.config.enabled:
+            return _AdmitGuard(self, None)
+        with self._lock:
+            st = self._state(tenant)
+            wait = st.bucket.try_take()
+            if wait > 0.0:
+                self._shed.labels(surface="quota",
+                                  app=tenant.label).inc()
+                raise OverloadedError(
+                    f"app '{tenant.label}' over its rate quota "
+                    f"({st.quota.rate:g} req/s)",
+                    retry_after=max(wait, 0.05), status=429)
+            cap = int(st.quota.concurrency or 0)
+            if cap > 0 and st.inflight >= cap:
+                self._shed.labels(surface="quota",
+                                  app=tenant.label).inc()
+                raise OverloadedError(
+                    f"app '{tenant.label}' at its concurrency quota "
+                    f"({cap} in flight)",
+                    retry_after=0.05, status=429)
+            st.inflight += 1
+        self._admitted.labels(app=tenant.label).inc()
+        return _AdmitGuard(self, tenant)
+
+    def _release(self, tenant: TenantIdentity) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant.label)
+            if st is not None and st.inflight > 0:
+                st.inflight -= 1
+
+
+class _AdmitGuard:
+    """Releases the concurrency slot admit() took; `with` scoped."""
+
+    __slots__ = ("_ctl", "_tenant")
+
+    def __init__(self, ctl: AdmissionController,
+                 tenant: Optional[TenantIdentity]):
+        self._ctl = ctl
+        self._tenant = tenant
+
+    def __enter__(self) -> "_AdmitGuard":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._tenant is not None:
+            self._ctl._release(self._tenant)
+        return False

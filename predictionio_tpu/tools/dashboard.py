@@ -133,9 +133,34 @@ def _series_rows(name: str, fam: dict) -> list:
 
 # serving-performance families: the "is the hot path on the device" view
 # (dispatch mix, backend recompiles, deploy warmup cost, coalesced batch
-# sizes)
+# sizes, and model staleness — freshness sits next to serve latency so
+# an operator sees "fast but stale" at a glance)
 _SERVING_PREFIXES = ("pio_topk_dispatch", "pio_jax_backend_compile",
-                     "pio_serve_warmup", "pio_serve_batch_size")
+                     "pio_serve_warmup", "pio_serve_batch_size",
+                     "pio_freshness_seconds")
+
+# multi-tenant admission families: per-app serve latency, quota sheds
+# (pio_shed_total{surface=quota,...}), admitted counts, and live tenant
+# state — the fairness/quota view of a shared fleet
+_TENANCY_PREFIXES = ("pio_tenant", "pio_shed_total")
+
+
+def _tenancy_panel(snapshot: dict) -> str:
+    """Summary table of the multi-tenant admission families: which app
+    is being shed on which surface, per-app latency distributions, and
+    how many tenants hold live admission state."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_TENANCY_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Multi-tenant admission</h2>"
+                "<p>No per-app serve/shed activity recorded yet "
+                "(tenancy off, or no queries).</p>")
+    return ("<h2>Multi-tenant admission</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
 
 
 def _serving_panel(snapshot: dict) -> str:
@@ -188,7 +213,8 @@ def _metrics_page(metrics: MetricsRegistry) -> str:
         "<meta http-equiv='refresh' content='5'></head>"
         "<body><h1>Live metrics</h1>"
         "<p>Prometheus text format: <a href='/metrics'>/metrics</a></p>"
-        + _serving_panel(snapshot) + _durability_panel(snapshot) +
+        + _serving_panel(snapshot) + _tenancy_panel(snapshot)
+        + _durability_panel(snapshot) +
         "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
         "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")

@@ -42,6 +42,13 @@ Checks, per source file:
     per-tick accumulation into process-lifetime state is an unbounded
     memory leak; keep per-tick state tick-local, or mark a genuinely
     bounded accumulator ``# lint: ok``
+  - tenancy layers (tenancy/, serving/) must not grow tenant-keyed
+    containers unboundedly — ``x[...] = ...`` / ``.setdefault(`` on a
+    name containing ``tenant``/``lane`` is per-REMOTE-PRINCIPAL state:
+    an attacker cycling access keys (or a fleet serving many apps)
+    grows it forever. Route the state through a capped structure
+    (``tenancy.admission.BoundedTenantMap``) or mark a write whose
+    bound is enforced elsewhere ``# lint: ok``
 
 Escape hatch: a line containing ``# lint: ok`` is skipped for line-based
 rules; a file listed in EXEMPT is skipped entirely.
@@ -65,7 +72,7 @@ _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
 
 # layers whose telemetry must flow through predictionio_tpu.obs
 _OBS_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
-             "predictionio_tpu/core/")
+             "predictionio_tpu/core/", "predictionio_tpu/tenancy/")
 
 # storage drivers: every durable write must be crash-atomic
 _STORAGE_DIRS = ("predictionio_tpu/data/storage/",)
@@ -73,7 +80,8 @@ _STORAGE_DIRS = ("predictionio_tpu/data/storage/",)
 # layers where unbounded waits and ad-hoc sleep loops are forbidden —
 # everything on a request or storage path must finish or fail in
 # bounded time (predictionio_tpu.resilience supplies the bounded forms)
-_RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/")
+_RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
+                   "predictionio_tpu/tenancy/")
 
 # device hot paths: implicit device->host transfers (np.asarray /
 # np.array / float() on a jax array) force a blocking sync per call
@@ -87,6 +95,13 @@ _MODELS_DIRS = ("predictionio_tpu/models/",)
 # streaming hot loops: the refresher ticks for the process lifetime, so
 # accumulating into module-level state grows without bound
 _STREAMING_DIRS = ("predictionio_tpu/streaming/",)
+
+# multi-tenant admission layers: tenant-keyed state is per-REMOTE-
+# PRINCIPAL memory, which an access-key-cycling client grows at will
+_TENANCY_DIRS = ("predictionio_tpu/tenancy/", "predictionio_tpu/serving/")
+
+# container-name fragments the tenant-growth rule keys on
+_TENANT_NAME_FRAGMENTS = ("tenant", "lane")
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -435,6 +450,65 @@ def _check_streaming_accumulation(tree: ast.AST, text: str,
                "tick-local, or mark a bounded accumulator '# lint: ok'")
 
 
+def _tenant_named(node: ast.AST) -> str:
+    """The tenant-suggesting name behind an expression, or ''."""
+    name = ""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    low = name.lower()
+    return name if any(f in low for f in _TENANT_NAME_FRAGMENTS) else ""
+
+
+def _check_tenant_growth(tree: ast.AST, text: str,
+                         rel: str) -> Iterator[str]:
+    """In tenancy/ and serving/: forbid raw growth of tenant-keyed
+    containers — ``x[key] = v`` subscript assignment or
+    ``.setdefault(`` on any name containing ``tenant``/``lane``. Each
+    entry is state held per remote principal: a client cycling access
+    keys (or a router fronting thousands of apps) makes it grow for
+    the process lifetime. The sanctioned shapes are the LRU-capped
+    ``tenancy.admission.BoundedTenantMap`` and the lane map inside
+    ``tenancy.drr.DRRQueue`` (evicts idle lanes past its cap); a write
+    whose bound is enforced elsewhere is marked ``# lint: ok`` on the
+    line."""
+    if not rel.startswith(_TENANCY_DIRS):
+        return
+    lines = text.splitlines()
+
+    def escaped(lineno: int) -> bool:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        return "# lint: ok" in line
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                name = _tenant_named(t.value)
+                if not name or escaped(node.lineno):
+                    continue
+                yield (f"{rel}:{node.lineno}: subscript-assign into "
+                       f"tenant-keyed '{name}' grows per-principal "
+                       "state without bound; use a capped map "
+                       "(tenancy.admission.BoundedTenantMap) or mark "
+                       "an externally-bounded write '# lint: ok'")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "setdefault":
+            name = _tenant_named(node.func.value)
+            if not name or escaped(node.lineno):
+                continue
+            yield (f"{rel}:{node.lineno}: .setdefault() into "
+                   f"tenant-keyed '{name}' grows per-principal state "
+                   "without bound; use a capped map "
+                   "(tenancy.admission.BoundedTenantMap) or mark an "
+                   "externally-bounded write '# lint: ok'")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -458,6 +532,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_device_transfers(tree, text, rel))
     out.extend(_check_training_reads(tree, text, rel))
     out.extend(_check_streaming_accumulation(tree, text, rel))
+    out.extend(_check_tenant_growth(tree, text, rel))
     return out
 
 

@@ -235,9 +235,11 @@ class HTTPServerBase:
         self.default_deadline_ms = default_deadline_ms
         self._limiter = InflightLimiter(
             max_inflight, surface=type(self).__name__)
+        # `app` attributes the shed to a tenant where one is known; the
+        # HTTP-plane inflight shed happens before auth, hence app=""
         self._shed_counter = self.metrics.counter(
             "pio_shed_total", "Requests shed by surface at admission",
-            labels=("surface",))
+            labels=("surface", "app"))
         self._deadline_counter = self.metrics.counter(
             "pio_deadline_expired_total",
             "Requests that exhausted their deadline", labels=("route",))
@@ -284,7 +286,8 @@ class HTTPServerBase:
                 with deadline_scope(req.deadline):
                     return self.router.dispatch(req)
         except OverloadedError as e:
-            self._shed_counter.labels(surface=self._limiter.surface).inc()
+            self._shed_counter.labels(surface=self._limiter.surface,
+                                      app="").inc()
             return Response.json(
                 {"message": e.message}, e.status,
                 **{"Retry-After": str(max(1, round(e.retry_after)))})

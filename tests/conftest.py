@@ -9,6 +9,13 @@ first initialization, hence the env mutation at import time.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic serve state: without this, any PredictionServer.stop() in a
+# test persists its dispatch EWMAs + observed batch-size histogram to
+# the default ~/.pio_store/serving/, and LATER tests (or later runs)
+# restore that foreign history — narrowed warm buckets then recompile
+# mid-test and trip the zero-recompile gates. Tests that exercise the
+# persistence itself monkeypatch PIO_DISPATCH_STATE to a tmp path.
+os.environ["PIO_DISPATCH_STATE"] = "off"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

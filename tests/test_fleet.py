@@ -44,6 +44,7 @@ from predictionio_tpu.serving import (
     FleetConfig, FleetServer, PredictionServer, ServerConfig,
 )
 from predictionio_tpu.serving.server import _MicroBatcher
+from predictionio_tpu.tenancy import DEFAULT_TENANT
 
 pytestmark = pytest.mark.chaos
 
@@ -391,7 +392,9 @@ class TestAdaptiveShed:
         b = _MicroBatcher(0.005, 8, queue_max=16, submit_timeout_s=0.05)
         with b._lock:
             b._delay_ewma = 1.0          # way over the 50ms budget
-            b._pending.append((None, None, threading.Event(), {}, 0.0))
+            b._queue.push(DEFAULT_TENANT,
+                          (None, None, threading.Event(), {}, 0.0,
+                           DEFAULT_TENANT))
         with pytest.raises(OverloadedError) as ei:
             b.submit(_StubDep(), {"q": 1})
         assert "queue delay" in str(ei.value)

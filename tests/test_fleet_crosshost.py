@@ -490,7 +490,7 @@ class TestDeadlineShed:
         fleet.start()
         dialed = []
 
-        def hanging_proxy(rep, req, timeout):
+        def hanging_proxy(rep, req, timeout, extra_headers=None):
             dialed.append(rep.key)
             time.sleep(0.15)           # outlive the 100ms budget
             raise OSError("simulated replica hang")

@@ -336,3 +336,54 @@ def test_streaming_accumulation_gate_scoped_to_streaming(tmp_path):
         "    _ENGINES.append(e)\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_tenant_growth_gate_catches_unbounded_maps(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "tenancy" / "leaky.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "class Ctl:\n"
+        "    def __init__(self):\n"
+        "        self._tenants = {}\n"
+        "        self.lanes = {}\n"
+        "    def admit(self, app, v):\n"
+        "        self._tenants[app] = v\n"
+        "        self.lanes.setdefault(app, []).append(v)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "subscript-assign into tenant-keyed '_tenants'" in kinds
+    assert ".setdefault() into tenant-keyed 'lanes'" in kinds
+    assert "per-principal state" in kinds
+
+
+def test_tenant_growth_gate_allows_escape_and_other_names(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "serving" / "fine.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "class Batcher:\n"
+        "    def __init__(self):\n"
+        "        self._tenants = {}\n"
+        "        self._size_counts = {}\n"     # not tenant-keyed
+        "    def put(self, app, v, n):\n"
+        "        self._tenants[app] = v  # lint: ok (evicted at cap)\n"
+        "        self._size_counts[n] = 1\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_tenant_growth_gate_scoped_to_tenancy_and_serving(tmp_path):
+    # outside tenancy//serving/ a tenant-named dict is not admission
+    # state (e.g. a train-time per-app aggregation, bounded by the run)
+    ok = tmp_path / "predictionio_tpu" / "tools" / "report.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def summarize(rows):\n"
+        "    tenants = {}\n"
+        "    for r in rows:\n"
+        "        tenants[r.app] = r\n"
+        "    return tenants\n"
+    )
+    assert not lint.run(tmp_path)

@@ -1,0 +1,670 @@
+"""Multi-tenant admission control tests.
+
+Covers the tenancy/ package end to end: access-key auth on
+`/queries.json` (query param + Basic, and the off switch), per-tenant
+rate/concurrency quotas (429 + Retry-After + shed counters), DRR
+weighted fairness in the micro-batcher, per-tenant queue caps, quota
+overrides in the metadata store, deadline-aware batch admission, warm
+bucket autotuning, fleet header propagation, and the chaos scenario: a
+replica dies mid-overload and the well-behaved app loses nothing.
+"""
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli import ops
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.core.workflow import derive_warm_buckets
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App, TenantQuota
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.resilience import (
+    Deadline, DeadlineExceeded, OverloadedError,
+)
+from predictionio_tpu.serving import PredictionServer, ServerConfig
+from predictionio_tpu.serving.fleet import FleetConfig, FleetServer
+from predictionio_tpu.serving.server import _MicroBatcher
+from predictionio_tpu.tenancy import (
+    DEFAULT_TENANT, TENANT_HEADER, AdmissionController, BoundedTenantMap,
+    DRRQueue, TenancyConfig, TenantIdentity,
+)
+from predictionio_tpu.tenancy.admission import _TokenBucket
+
+VICTIM_KEY = "SKEY"
+AGGRO_KEY = "AKEY"
+
+
+def call(port, method, path, body=None, headers=None):
+    """Like test_serving.call but with request headers and the response
+    headers in the return (Retry-After assertions need them)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return (resp.status,
+                    json.loads(raw) if "json" in ct else raw,
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _metric(name, **labels):
+    return get_registry().value(name, **labels)
+
+
+@pytest.fixture()
+def trained(mem_registry):
+    """Trained registry with TWO apps: `servapp` (the victim, owns the
+    training data) and `aggro` (the aggressor — auth only, the model is
+    shared across tenants)."""
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "servapp"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey(VICTIM_KEY, app_id, ()))
+    aggro_id = apps.insert(App(0, "aggro"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey(AGGRO_KEY, aggro_id, ()))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="servapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4, seed=1)),))
+    row = CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine, row, app_id
+
+
+def start_server(registry, engine, **cfg):
+    config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+    srv = PredictionServer(config, registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+# -- primitives ---------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_wait_estimate(self):
+        b = _TokenBucket(rate=10.0, burst=2.0)
+        assert b.try_take() == 0.0
+        assert b.try_take() == 0.0
+        wait = b.try_take()
+        assert 0.0 < wait <= 0.1 + 1e-6
+
+    def test_refill_readmits(self):
+        b = _TokenBucket(rate=1000.0, burst=1.0)
+        assert b.try_take() == 0.0
+        assert b.try_take() > 0.0
+        time.sleep(0.01)
+        assert b.try_take() == 0.0
+
+    def test_zero_rate_blocks(self):
+        b = _TokenBucket(rate=0.0, burst=1.0)
+        assert b.try_take() == 0.0
+        assert b.try_take() == 1.0     # flat penalty, never refills
+
+
+class TestBoundedTenantMap:
+    def test_lru_eviction_keeps_active(self):
+        m = BoundedTenantMap(2)
+        m.put("a", 1)
+        m.put("b", 2)
+        assert m.get("a") == 1         # refresh "a"
+        m.put("c", 3)                  # evicts "b", the stalest
+        assert "a" in m and "c" in m and "b" not in m
+        assert len(m) == 2
+
+
+class TestDRRQueue:
+    def test_single_lane_is_fifo(self):
+        q = DRRQueue()
+        for i in range(5):
+            assert q.push(DEFAULT_TENANT, i)
+        assert q.take(5) == [0, 1, 2, 3, 4]
+        assert len(q) == 0
+
+    def test_weighted_drain_ratio(self):
+        q = DRRQueue(quantum=1.0)
+        for i in range(100):
+            q.push("victim", ("v", i), weight=3.0)
+            q.push("aggro", ("a", i), weight=1.0)
+        out = q.take(40)
+        by = {"v": 0, "a": 0}
+        for who, _ in out:
+            by[who] += 1
+        # 3:1 weights -> 30/10 split (integer deficits make it exact)
+        assert by["v"] == 30 and by["a"] == 10
+
+    def test_equal_weights_interleave(self):
+        q = DRRQueue(quantum=1.0)
+        for i in range(10):
+            q.push("x", ("x", i))
+            q.push("y", ("y", i))
+        out = q.take(10)
+        by = {"x": 0, "y": 0}
+        for who, _ in out:
+            by[who] += 1
+        assert by == {"x": 5, "y": 5}
+
+    def test_lane_cap_sheds_only_that_tenant(self):
+        q = DRRQueue()
+        assert q.push("a", 1, queue_max=2)
+        assert q.push("a", 2, queue_max=2)
+        assert not q.push("a", 3, queue_max=2)   # a's lane full
+        assert q.push("b", 1, queue_max=2)       # b unaffected
+        assert q.depth("a") == 2 and q.depth("b") == 1
+
+    def test_remove_and_drain_all(self):
+        q = DRRQueue()
+        q.push("a", "x")
+        q.push("a", "y")
+        q.push("b", "z")
+        assert q.remove("a", "x")
+        assert not q.remove("a", "x")            # already gone
+        assert not q.remove("ghost", "x")
+        assert sorted(q.drain_all()) == ["y", "z"]
+        assert len(q) == 0
+
+    def test_idle_lane_evicted_at_cap(self):
+        q = DRRQueue(max_tenants=2)
+        q.push("t1", 1)
+        assert q.take(1) == [1]                  # t1 now empty
+        q.push("t2", 2)
+        q.push("t3", 3)                          # cap hit: t1 dropped
+        assert "t1" not in q.tenants()
+        assert set(q.tenants()) == {"t2", "t3"}
+
+    def test_per_tenant_delay_ewma(self):
+        q = DRRQueue()
+        q.push("a", 1)
+        q.push("b", 2)
+        q.observe_delay("a", 1.0)
+        q.observe_delay("b", 0.1)
+        assert q.delay_ewma("a") > q.delay_ewma("b") > 0.0
+        worst, ewma = q.max_delay_ewma()
+        assert worst == "a" and ewma == q.delay_ewma("a")
+        assert q.delay_ewma("nobody") == 0.0
+
+
+# -- config -------------------------------------------------------------------
+
+class TestTenancyConfig:
+    def test_from_env_parses_knobs(self):
+        cfg = TenancyConfig.from_env({
+            "PIO_TENANCY": "on", "PIO_TENANT_RATE": "5.5",
+            "PIO_TENANT_BURST": "9", "PIO_TENANT_CONCURRENCY": "3",
+            "PIO_TENANT_QUEUE_MAX": "7", "PIO_TENANT_MAX": "11"})
+        assert cfg.enabled and cfg.rate == 5.5 and cfg.burst == 9.0
+        assert cfg.concurrency == 3 and cfg.queue_max == 7
+        assert cfg.max_tenants == 11
+
+    def test_defaults_off_and_overrides_win(self):
+        assert not TenancyConfig.from_env({}).enabled
+        cfg = TenancyConfig.from_env({"PIO_TENANT_RATE": "5"},
+                                     enabled=True, rate=42.0)
+        assert cfg.enabled and cfg.rate == 42.0
+
+    def test_bad_value_raises(self):
+        with pytest.raises(ValueError, match="PIO_TENANT_"):
+            TenancyConfig.from_env({"PIO_TENANT_RATE": "fast"})
+
+    def test_replica_variant_trusts_header(self):
+        cfg = TenancyConfig(enabled=True)
+        rep = cfg.replica_variant()
+        assert rep.trust_header and not cfg.trust_header
+        assert rep.enabled
+
+
+# -- quota store + CLI ops ----------------------------------------------------
+
+class TestQuotaStore:
+    def test_merged_over_inherits_unset_fields(self):
+        default = TenantQuota(appid=0, rate=100.0, burst=200.0,
+                              concurrency=0, queue_max=64, weight=1.0)
+        override = TenantQuota(appid=7, rate=5.0)
+        eff = override.merged_over(default)
+        assert eff.appid == 7 and eff.rate == 5.0
+        assert eff.burst == 200.0 and eff.queue_max == 64
+        assert eff.weight == 1.0
+
+    def test_dao_crud(self, mem_registry):
+        dao = mem_registry.get_meta_data_tenant_quotas()
+        assert dao.get(1) is None
+        dao.upsert(TenantQuota(appid=1, rate=5.0))
+        dao.upsert(TenantQuota(appid=2, weight=4.0))
+        assert dao.get(1).rate == 5.0
+        assert {q.appid for q in dao.get_all()} == {1, 2}
+        dao.upsert(TenantQuota(appid=1, rate=9.0))   # replace
+        assert dao.get(1).rate == 9.0
+        dao.delete(1)
+        assert dao.get(1) is None
+
+    def test_cli_quota_set_show_delete(self, mem_registry):
+        mem_registry.get_meta_data_apps().insert(App(0, "qapp"))
+        out = ops.app_quota_set(mem_registry, "qapp", rate=5.0)
+        assert out["quota"]["rate"] == 5.0
+        assert out["quota"]["weight"] is None
+        # second set merges over the stored row: rate survives
+        out = ops.app_quota_set(mem_registry, "qapp", weight=3.0)
+        assert out["quota"]["rate"] == 5.0 and out["quota"]["weight"] == 3.0
+        ops.app_quota_delete(mem_registry, "qapp")
+        assert ops.app_quota_show(
+            mem_registry, "qapp")["quota"]["rate"] is None
+
+    def test_cli_quota_unknown_app(self, mem_registry):
+        with pytest.raises(ValueError):
+            ops.app_quota_show(mem_registry, "nope")
+
+
+# -- admission controller (no HTTP) -------------------------------------------
+
+class TestAdmissionController:
+    def _ctl(self, registry=None, **cfg):
+        cfg.setdefault("enabled", True)
+        return AdmissionController(TenancyConfig(**cfg), registry=registry)
+
+    def test_rate_quota_sheds_429_with_retry_after(self):
+        ctl = self._ctl(rate=0.01, burst=2.0)
+        ident = TenantIdentity(app_id=1, label="rateapp")
+        before = _metric("pio_shed_total", surface="quota", app="rateapp")
+        with ctl.admit(ident):
+            pass
+        with ctl.admit(ident):
+            pass
+        with pytest.raises(OverloadedError) as ei:
+            ctl.admit(ident)
+        assert ei.value.status == 429 and ei.value.retry_after > 0
+        assert _metric("pio_shed_total", surface="quota",
+                       app="rateapp") == before + 1
+        assert _metric("pio_tenant_admitted_total", app="rateapp") >= 2
+
+    def test_concurrency_quota_releases_on_exit(self):
+        ctl = self._ctl(rate=1e6, burst=1e6, concurrency=1)
+        ident = TenantIdentity(app_id=1, label="conapp")
+        guard = ctl.admit(ident)
+        with pytest.raises(OverloadedError) as ei:
+            ctl.admit(ident)
+        assert ei.value.status == 429
+        guard.__exit__(None, None, None)         # slot released
+        with ctl.admit(ident):
+            pass
+
+    def test_pre_admitted_identity_not_recharged(self):
+        ctl = self._ctl(rate=0.01, burst=1.0)
+        ident = TenantIdentity(app_id=1, label="fleetapp",
+                               pre_admitted=True)
+        for _ in range(10):                      # leader already paid
+            with ctl.admit(ident):
+                pass
+
+    def test_disabled_tenancy_passes_through(self):
+        ctl = self._ctl(enabled=False, rate=0.0, burst=1.0)
+        for _ in range(5):
+            with ctl.admit(None):
+                pass
+            with ctl.admit(TenantIdentity(app_id=1, label="x")):
+                pass
+
+    def test_store_override_beats_defaults(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "ovr"))
+        mem_registry.get_meta_data_tenant_quotas().upsert(
+            TenantQuota(appid=app_id, rate=0.01, burst=1.0))
+        ctl = self._ctl(registry=mem_registry, rate=1e6, burst=1e6)
+        ident = TenantIdentity(app_id=app_id, label="ovr")
+        assert ctl.quota(ident).rate == 0.01
+        with ctl.admit(ident):
+            pass
+        with pytest.raises(OverloadedError):
+            ctl.admit(ident)
+
+    def test_batch_params_use_override_weight(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "wapp"))
+        mem_registry.get_meta_data_tenant_quotas().upsert(
+            TenantQuota(appid=app_id, weight=4.0, queue_max=9))
+        ctl = self._ctl(registry=mem_registry)
+        label, weight, qmax = ctl.batch_params(
+            TenantIdentity(app_id=app_id, label="wapp"))
+        assert (label, weight, qmax) == ("wapp", 4.0, 9)
+        # tenancy off / anonymous -> the default FIFO lane, uncapped
+        assert ctl.batch_params(None) == (DEFAULT_TENANT, 1.0, 0)
+
+    def test_header_parse_roundtrip(self):
+        ident = TenantIdentity(app_id=7, label="servapp")
+        parsed = AdmissionController._parse_header(ident.header_value())
+        assert parsed.app_id == 7 and parsed.label == "servapp"
+        assert parsed.pre_admitted
+        assert AdmissionController._parse_header("garbage") is None
+        assert AdmissionController._parse_header("x:y") is None
+
+
+# -- micro-batcher: deadline_batch + autotune ---------------------------------
+
+class _StubDep:
+    def predict_batch(self, queries):
+        return list(queries)
+
+
+class TestDeadlineBatchAdmission:
+    def test_budget_below_window_plus_drain_sheds_504(self):
+        b = _MicroBatcher(0.05, 8, submit_timeout_s=1.0)
+        with b._lock:
+            b._drain_ewma = 0.2          # batches take ~200ms to drain
+        before = _metric("pio_shed_total", surface="deadline_batch",
+                         app=DEFAULT_TENANT)
+        with pytest.raises(DeadlineExceeded, match="batch window"):
+            b.submit(_StubDep(), 1, deadline=Deadline.after_s(0.01))
+        assert _metric("pio_shed_total", surface="deadline_batch",
+                       app=DEFAULT_TENANT) == before + 1
+
+    def test_first_request_admits_with_no_drain_history(self):
+        # drain EWMA starts 0: the estimate has no evidence, so a tight
+        # deadline is given its chance instead of a reflexive 504
+        b = _MicroBatcher(0.001, 4, submit_timeout_s=1.0)
+        assert b.submit(_StubDep(), 5,
+                        deadline=Deadline.after_s(0.5)) == 5
+
+    def test_generous_budget_admits_despite_drain_history(self):
+        b = _MicroBatcher(0.001, 4, submit_timeout_s=1.0)
+        with b._lock:
+            b._drain_ewma = 0.01
+        assert b.submit(_StubDep(), 3,
+                        deadline=Deadline.after_s(5.0)) == 3
+
+
+class TestWarmBucketAutotune:
+    def test_full_ladder_without_history(self):
+        assert derive_warm_buckets(64) == [1, 2, 4, 8, 16, 32, 64]
+        assert derive_warm_buckets(64, {}) == [1, 2, 4, 8, 16, 32, 64]
+
+    def test_history_narrows_to_observed_shapes(self):
+        assert derive_warm_buckets(64, {8: 100, 64: 2}) == [1, 8, 64]
+
+    def test_non_pow2_sizes_clamp_down_and_one_always_kept(self):
+        assert derive_warm_buckets(64, {6: 3}) == [1, 4]
+        assert derive_warm_buckets(64, {1: 9}) == [1]
+
+    def test_oversized_and_zero_count_entries_ignored(self):
+        assert derive_warm_buckets(8, {512: 4, 2: 0, 4: 1}) == [1, 4, 8]
+
+    def test_batcher_histogram_pow2_and_restore(self):
+        b = _MicroBatcher(0.001, 8, submit_timeout_s=2.0)
+        assert b.submit(_StubDep(), 1) == 1      # batch of 1 -> bucket 1
+        counts = b.size_counts()
+        assert counts.get(1, 0) >= 1
+        b2 = _MicroBatcher(0.001, 8)
+        b2.restore_size_counts({"8": 3, "junk": "x", "2": 1})
+        assert b2.size_counts() == {8: 3, 2: 1}
+
+    def test_server_persists_size_histogram(self, trained, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("PIO_DISPATCH_STATE",
+                           str(tmp_path / "dispatch_policy.json"))
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, batch_window_ms=5)
+        try:
+            status, _, _ = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 200
+        finally:
+            srv.stop()
+        sizes = json.loads((tmp_path / "batch_sizes.json").read_text())
+        assert sizes and all(int(k) >= 1 for k in sizes)
+
+
+# -- live server auth + quotas ------------------------------------------------
+
+class TestServeAuth:
+    def test_tenancy_off_serves_anonymously(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            status, body, _ = call(srv.port, "POST", "/queries.json",
+                                   {"user": "u1", "num": 2})
+            assert status == 200 and len(body["itemScores"]) == 2
+        finally:
+            srv.stop()
+
+    def test_auth_required_when_enabled(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine,
+                           tenancy=TenancyConfig(enabled=True))
+        try:
+            status, body, _ = call(srv.port, "POST", "/queries.json",
+                                   {"user": "u1", "num": 2})
+            assert status == 401 and "Missing accessKey" in body["message"]
+            status, body, _ = call(
+                srv.port, "POST", "/queries.json?accessKey=WRONG",
+                {"user": "u1", "num": 2})
+            assert status == 401 and "Invalid accessKey" in body["message"]
+            status, body, _ = call(
+                srv.port, "POST", f"/queries.json?accessKey={VICTIM_KEY}",
+                {"user": "u1", "num": 2})
+            assert status == 200 and len(body["itemScores"]) == 2
+        finally:
+            srv.stop()
+
+    def test_basic_auth_accepted(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine,
+                           tenancy=TenancyConfig(enabled=True))
+        try:
+            token = base64.b64encode(f"{VICTIM_KEY}:".encode()).decode()
+            status, body, _ = call(
+                srv.port, "POST", "/queries.json", {"user": "u1", "num": 2},
+                headers={"Authorization": f"Basic {token}"})
+            assert status == 200 and len(body["itemScores"]) == 2
+        finally:
+            srv.stop()
+
+    def test_rate_quota_shed_429_retry_after_and_metrics(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine,
+                           tenancy=TenancyConfig(enabled=True, rate=0.01,
+                                                 burst=2.0))
+        shed0 = _metric("pio_shed_total", surface="quota", app="servapp")
+        try:
+            path = f"/queries.json?accessKey={VICTIM_KEY}"
+            for _ in range(2):
+                status, _, _ = call(srv.port, "POST", path,
+                                    {"user": "u1", "num": 2})
+                assert status == 200
+            status, body, headers = call(srv.port, "POST", path,
+                                         {"user": "u1", "num": 2})
+            assert status == 429
+            assert "rate quota" in body["message"]
+            assert int(headers["Retry-After"]) >= 1
+            assert _metric("pio_shed_total", surface="quota",
+                           app="servapp") == shed0 + 1
+            assert _metric("pio_tenant_admitted_total", app="servapp") >= 2
+            assert _metric("pio_tenant_active") >= 1.0
+        finally:
+            srv.stop()
+
+    def test_per_tenant_serve_histogram_recorded(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine,
+                           tenancy=TenancyConfig(enabled=True))
+        try:
+            status, _, _ = call(
+                srv.port, "POST", f"/queries.json?accessKey={VICTIM_KEY}",
+                {"user": "u1", "num": 2})
+            assert status == 200
+        finally:
+            srv.stop()
+        fam = get_registry().snapshot().get("pio_tenant_serve_seconds")
+        assert fam is not None
+        apps = {s["labels"].get("app") for s in fam["series"]}
+        assert "servapp" in apps
+
+    def test_ignores_trust_header_unless_replica(self, trained):
+        """A standalone (non-replica) server must never honor the fleet
+        identity header — that would be an auth bypass."""
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine,
+                           tenancy=TenancyConfig(enabled=True))
+        try:
+            status, body, _ = call(
+                srv.port, "POST", "/queries.json", {"user": "u1", "num": 2},
+                headers={TENANT_HEADER: "1:servapp"})
+            assert status == 401
+        finally:
+            srv.stop()
+
+
+# -- fleet: identity propagation + chaos --------------------------------------
+
+def _start_fleet(trained, tenancy, replicas=3, **fleet_kw):
+    registry, engine, _, _ = trained
+    fleet_kw.setdefault("health_interval_s", 0.1)
+    fleet_kw.setdefault("eject_threshold", 2)
+    fleet_kw.setdefault("drain_timeout_s", 2.0)
+    srv = FleetServer(ServerConfig(ip="127.0.0.1", port=0, tenancy=tenancy),
+                      FleetConfig(replicas=replicas, **fleet_kw),
+                      registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+class _KeyedLoader:
+    """Open-loop-ish hammer for one app's access key."""
+
+    def __init__(self, port, key, threads=2):
+        self.port = port
+        self.key = key
+        self.halt = threading.Event()
+        self.statuses = []
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(threads)]
+
+    def _run(self):
+        while not self.halt.is_set():
+            try:
+                status, _, _ = call(
+                    self.port, "POST",
+                    f"/queries.json?accessKey={self.key}",
+                    {"user": "u1", "num": 2})
+            except OSError:
+                status = -1
+            with self._lock:
+                self.statuses.append(status)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    def by_status(self):
+        with self._lock:
+            out = {}
+            for s in self.statuses:
+                out[s] = out.get(s, 0) + 1
+            return out
+
+
+class TestFleetTenancy:
+    def test_leader_authenticates_and_propagates_identity(self, trained):
+        fleet = _start_fleet(
+            trained, TenancyConfig(enabled=True, rate=1e6, burst=1e6),
+            replicas=2)
+        try:
+            # unauthenticated at the router: 401 before any dial
+            status, body, _ = call(fleet.port, "POST", "/queries.json",
+                                   {"user": "u1", "num": 2})
+            assert status == 401
+            # router must NOT trust the identity header from clients
+            status, _, _ = call(fleet.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2},
+                                headers={TENANT_HEADER: "1:servapp"})
+            assert status == 401
+            # authenticated: leader resolves + charges, replica serves
+            admitted0 = _metric("pio_tenant_admitted_total", app="servapp")
+            status, body, _ = call(
+                fleet.port, "POST",
+                f"/queries.json?accessKey={VICTIM_KEY}",
+                {"user": "u1", "num": 2})
+            assert status == 200 and len(body["itemScores"]) == 2
+            # quota charged exactly ONCE (leader), not again per replica
+            assert _metric("pio_tenant_admitted_total",
+                           app="servapp") == admitted0 + 1
+            # replicas run trust_header: the forwarded header IS the
+            # identity, so direct traffic with it serves without a key
+            rep = fleet._replicas[0]
+            status, body, _ = call(
+                rep.port, "POST", "/queries.json", {"user": "u1", "num": 2},
+                headers={TENANT_HEADER: "1:servapp"})
+            assert status == 200
+            # ...but direct traffic with NO credentials still 401s
+            status, _, _ = call(rep.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 2})
+            assert status == 401
+        finally:
+            fleet.stop()
+
+    def test_replica_killed_mid_overload_victim_losslessly_served(
+            self, trained):
+        """The ISSUE chaos gate: an aggressor app hammers the fleet 10x
+        past its quota while a replica dies abruptly. The victim app —
+        inside its quota — must not lose a single request; the
+        aggressor's overflow sheds under surface=quota."""
+        registry, _, _, _ = trained
+        aggro_id = registry.get_meta_data_apps().get_by_name("aggro").id
+        registry.get_meta_data_tenant_quotas().upsert(
+            TenantQuota(appid=aggro_id, rate=20.0, burst=5.0))
+        shed0 = _metric("pio_shed_total", surface="quota", app="aggro")
+        fleet = _start_fleet(
+            trained, TenancyConfig(enabled=True, rate=1e5, burst=1e5),
+            replicas=3)
+        try:
+            victim_rep = fleet._replicas[0]
+            with _KeyedLoader(fleet.port, VICTIM_KEY) as victim, \
+                    _KeyedLoader(fleet.port, AGGRO_KEY, threads=3) as aggro:
+                waiter = threading.Event()
+                waiter.wait(0.3)                 # both apps flowing
+                victim_rep.server.shutdown()     # abrupt death, no drain
+                waiter.wait(0.4)                 # overload continues
+            victim_out = victim.by_status()
+            aggro_out = aggro.by_status()
+        finally:
+            fleet.stop()
+        # zero victim loss: every request the victim sent came back 200
+        assert set(victim_out) == {200}, victim_out
+        assert victim_out[200] > 0
+        # the aggressor got throttled, and only under the quota surface
+        assert aggro_out.get(429, 0) > 0, aggro_out
+        assert _metric("pio_shed_total", surface="quota",
+                       app="aggro") > shed0
+        # and its admitted trickle (within quota) still served fine
+        assert set(aggro_out) <= {200, 429}, aggro_out
