@@ -366,8 +366,11 @@ def main(argv: Optional[list] = None) -> int:
             if args.join:
                 # standalone replica: serve locally, register with (and
                 # heartbeat) every router listed. The joined routers are
-                # the auth + quota boundary; this replica trusts their
-                # X-PIO-App assertion and applies only the fairness layer
+                # the auth + quota boundary; this replica honors their
+                # X-PIO-App assertion — verified against the shared
+                # PIO_SERVER_ACCESS_KEY — and applies only the fairness
+                # layer (no key on either side = header refused, key
+                # auth re-runs here)
                 config = dataclasses.replace(
                     config, tenancy=tenancy.replica_variant())
                 server = PredictionServer(config, registry=registry)

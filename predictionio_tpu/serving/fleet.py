@@ -231,6 +231,16 @@ class FleetServer(HTTPServerBase):
         )
         tcfg = (config.tenancy if config.tenancy is not None
                 else TenancyConfig.from_env())
+        if tcfg.enabled and not tcfg.header_key:
+            # no operator-configured PIO_SERVER_ACCESS_KEY: mint an
+            # ephemeral per-fleet secret so in-process replicas can
+            # still VERIFY the router's X-PIO-App assertion instead of
+            # trusting any client that dials them directly. Cross-host
+            # (--join) replicas can't see this token — they need the
+            # shared PIO_SERVER_ACCESS_KEY and warn otherwise.
+            import secrets
+            tcfg = dataclasses.replace(
+                tcfg, header_key=secrets.token_hex(16))
         self.admission = AdmissionController(
             tcfg, registry=self.ctx.registry, metrics=self.metrics)
         self._engine_arg = engine
@@ -945,7 +955,9 @@ class FleetServer(HTTPServerBase):
             from predictionio_tpu.tenancy import TENANT_HEADER
             tenant = self.admission.resolve(req)
             with self.admission.admit(tenant):
-                extra = ({TENANT_HEADER: tenant.header_value()}
+                # HMAC-signed assertion: replicas verify before
+                # honoring, so only this router can mint identities
+                extra = ({TENANT_HEADER: self.admission.signed_header(tenant)}
                          if tenant is not None else None)
                 return self._route(req, extra_headers=extra)
 

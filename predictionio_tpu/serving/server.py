@@ -360,6 +360,12 @@ class _MicroBatcher:
         # check's estimate of "how long until a batch admitted now
         # actually returns"
         self._drain_ewma = 0.0
+        # when the estimate last saw a real drain: the deadline check
+        # ages the EWMA toward zero from here, so a one-off stall (a
+        # serve-time recompile, say) cannot shed ALL deadlined traffic
+        # forever — shed requests never enqueue, so without decay no
+        # batch would ever drain to correct the estimate
+        self._drain_t = time.perf_counter()
         # observed pow2 batch-size counts (≤ log2(batch_max) keys, so
         # bounded by construction); feeds warm_deploy bucket autotune
         self._size_counts: Dict[int, int] = {}
@@ -370,9 +376,27 @@ class _MicroBatcher:
             return self._delay_ewma
 
     def drain_time_ewma(self) -> float:
-        """Smoothed batch-processing wall time (seconds)."""
+        """Smoothed batch-processing wall time (seconds), aged."""
         with self._lock:
+            return self._drain_estimate_locked()
+
+    def _drain_estimate_locked(self) -> float:
+        """The drain EWMA, halved per grace interval without a drain.
+
+        Unlike the queue_delay shedder — whose pending-work gate lets
+        admitted traffic decay a stale spike — the deadline check runs
+        BEFORE enqueue, so a poisoned estimate would be
+        self-sustaining: everything sheds, nothing drains, nothing
+        corrects. Aging the estimate on the wall clock breaks that
+        loop; the grace period (a few expected drain cycles) keeps the
+        estimate honest under normal traffic gaps."""
+        if self._drain_ewma <= 0.0:
             return self._drain_ewma
+        grace = max(4.0 * (self.window_s + self._drain_ewma), 1.0)
+        idle = time.perf_counter() - self._drain_t
+        if idle <= grace:
+            return self._drain_ewma
+        return self._drain_ewma * 0.5 ** ((idle - grace) / grace)
 
     def size_counts(self) -> Dict[int, int]:
         """Observed batch sizes, rounded up to pow2 -> drain count."""
@@ -417,15 +441,17 @@ class _MicroBatcher:
                 # deadline-aware admission: even an EMPTY queue costs
                 # one window + one drain; a budget below that dies in
                 # the batch, so shed it 504 now and keep the slot for
-                # work that can finish
-                if self._drain_ewma > 0.0 and \
-                        budget < self.window_s + self._drain_ewma:
+                # work that can finish (the aged estimate, so a one-off
+                # stall cannot lock deadlined traffic out for good)
+                drain_est = self._drain_estimate_locked()
+                if drain_est > 0.0 and \
+                        budget < self.window_s + drain_est:
                     self.obs.shed.labels(surface="deadline_batch",
                                          app=tenant).inc()
                     raise DeadlineExceeded(
                         f"deadline budget {budget * 1e3:.0f}ms below "
                         f"batch window + drain estimate "
-                        f"{(self.window_s + self._drain_ewma) * 1e3:.0f}ms")
+                        f"{(self.window_s + drain_est) * 1e3:.0f}ms")
             # adaptive shed: don't queue work predicted to expire
             # there. Tenanted submits judge their OWN lane's delay
             # EWMA — the tenant whose backlog grows is the one shed —
@@ -505,8 +531,13 @@ class _MicroBatcher:
                 self._process(batch)
                 dt = time.perf_counter() - t0
                 with self._lock:
-                    self._drain_ewma += self.DELAY_ALPHA * (
-                        dt - self._drain_ewma)
+                    # blend into the AGED estimate: recovering from a
+                    # stall starts from the decayed value instead of
+                    # dragging the stale spike back in
+                    base = self._drain_estimate_locked()
+                    self._drain_ewma = base + self.DELAY_ALPHA * (
+                        dt - base)
+                    self._drain_t = time.perf_counter()
                 batch = []
         except BaseException as e:
             # drainer crash: fail every waiter NOW — the dequeued batch

@@ -24,11 +24,15 @@ per-app overrides live in the metadata store (`TenantQuotas` DAO) and
 are picked up within `overrides_ttl_s` — no redeploy to retune one app.
 
 Fleet trust model: the leader authenticates and charges quotas ONCE,
-then forwards identity to replicas in the `X-PIO-App` header. Replicas
-run with `trust_header=True` and skip re-auth/re-charge (fairness still
-applies per replica). The header is only honored when trust_header is
-set — a standalone server ignores it — and the fleet tier is assumed to
-sit on a private network (see the fleet transport note in README).
+then forwards identity to replicas in the `X-PIO-App` header, HMAC-
+signed with the fleet's shared `header_key` (PIO_SERVER_ACCESS_KEY, or
+an ephemeral per-fleet secret for in-process replicas). Replicas run
+with `trust_header=True` and skip re-auth/re-charge (fairness still
+applies per replica) — but only for headers whose signature verifies;
+a client dialing a replica directly cannot forge an identity, it falls
+through to normal access-key auth. A trust_header replica with no
+header_key refuses the header outright (and warns once): cross-host
+fleets must share PIO_SERVER_ACCESS_KEY.
 
 All per-tenant state is bounded: tenant maps are LRU-capped at
 `max_tenants` (the lint gate in tools/lint.py enforces this property
@@ -37,11 +41,14 @@ for any tenant-keyed container in tenancy/ + serving/).
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import re
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field, replace
-from typing import Mapping, Optional, Tuple
+from typing import Callable, Mapping, Optional, Tuple
 
 from predictionio_tpu.data.storage.base import TenantQuota
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
@@ -54,6 +61,11 @@ TENANT_HEADER = "X-PIO-App"
 # replica receives direct traffic): one shared FIFO lane, zero tenant
 # bookkeeping — the PIO_TENANCY=off serve path stays unchanged
 DEFAULT_TENANT = ""
+
+# app labels ride in HTTP headers and metrics label values: cap length
+# and charset so a forged/garbage label cannot explode metric
+# cardinality or smuggle header syntax
+_LABEL_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9_.\-]{0,63}")
 
 _log = get_logger("tenancy")
 
@@ -85,8 +97,13 @@ class TenancyConfig:
     # accept X-PIO-App from the fleet tier instead of re-authenticating
     # (set on fleet replicas only; implies the leader charged the quota)
     trust_header: bool = False
-    # how stale a cached per-app override may get before re-reading the
-    # metadata store
+    # shared secret signing the fleet identity header (HMAC-SHA256);
+    # from PIO_SERVER_ACCESS_KEY, or an ephemeral per-fleet token for
+    # in-process replicas. Empty on a trust_header replica = the header
+    # is never honored (refuse-by-default, not trust-by-default)
+    header_key: str = ""
+    # how stale a cached per-app override — and a cached positive
+    # access-key lookup — may get before re-reading the metadata store
     overrides_ttl_s: float = 10.0
 
     @staticmethod
@@ -112,6 +129,9 @@ class TenancyConfig:
                     kw[field_name] = cast(raw)
         except ValueError as e:
             raise ValueError(f"bad PIO_TENANT_* value: {e}") from e
+        server_key = cfg.get("PIO_SERVER_ACCESS_KEY")
+        if server_key:
+            kw["header_key"] = server_key
         kw.update(overrides)
         return TenancyConfig(**kw)
 
@@ -168,8 +188,18 @@ class BoundedTenantMap:
     least-recently-USED entry, so a scan of throwaway tenants cannot
     displace the active set faster than it refreshes itself."""
 
-    def __init__(self, cap: int):
+    def __init__(self, cap: int,
+                 evictable: Optional[Callable[[object], bool]] = None):
+        """`evictable`: optional predicate over VALUES; entries it
+        rejects are passed over at eviction time (e.g. tenant states
+        with requests still in flight, whose loss would leak
+        concurrency-quota slots — a recreated state restarts at
+        inflight=0 with a full bucket). The map may transiently exceed
+        `cap` while every entry is unevictable; that excess is bounded
+        by the server's own in-flight ceiling, so growth stays
+        bounded."""
         self.cap = max(1, int(cap))
+        self._evictable = evictable
         self._entries: "OrderedDict[str, object]" = OrderedDict()
 
     def get(self, key: str):
@@ -181,8 +211,20 @@ class BoundedTenantMap:
     def put(self, key: str, value) -> None:
         self._entries[key] = value
         self._entries.move_to_end(key)
-        while len(self._entries) > self.cap:
-            self._entries.popitem(last=False)
+        if len(self._entries) <= self.cap:
+            return
+        for k in list(self._entries):    # oldest -> newest
+            if len(self._entries) <= self.cap:
+                break
+            if k == key:
+                continue                 # never evict the fresh insert
+            if self._evictable is None \
+                    or self._evictable(self._entries[k]):
+                del self._entries[k]
+
+    def pop(self, key: str):
+        """Drop and return `key`'s entry (None when absent)."""
+        return self._entries.pop(key, None)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -205,10 +247,19 @@ class AdmissionController:
         self.registry = registry
         metrics = metrics if metrics is not None else get_registry()
         self._lock = threading.Lock()
-        self._tenants = BoundedTenantMap(config.max_tenants)
-        # access key -> TenantIdentity (positive entries only: a miss
-        # costs one DAO read, a bounded price for not caching garbage)
+        # states with requests in flight are pinned against LRU
+        # eviction: losing one mid-request would leak its concurrency
+        # slots (the replacement restarts at inflight=0)
+        self._tenants = BoundedTenantMap(
+            config.max_tenants,
+            evictable=lambda st: st.inflight <= 0)
+        # access key -> (TenantIdentity, load time). Positive entries
+        # only (a miss costs one DAO read, a bounded price for not
+        # caching garbage); entries re-validate after overrides_ttl_s
+        # so a revoked key stops serving within the TTL instead of
+        # living until LRU pressure happens to evict it
         self._keys = BoundedTenantMap(config.max_tenants)
+        self._warned_no_header_key = False
         self._shed = metrics.counter(
             "pio_shed_total", "Requests shed by surface at admission",
             labels=("surface", "app"))
@@ -237,30 +288,78 @@ class AdmissionController:
                 ident = self._parse_header(hv)
                 if ident is not None:
                     return ident
-            # direct traffic to a trusted-header replica (tests, ops
-            # probes) falls through to normal key auth
+            # an unsigned/forged header, or direct traffic to a
+            # trusted-header replica (tests, ops probes), falls
+            # through to normal key auth
         key = req.query_get("accessKey")
         if key is None:
             key = parse_basic_auth_user(req.headers)
             if key is None:
                 raise HTTPError(401, "Missing accessKey.")
+        now = time.monotonic()
         with self._lock:
             cached = self._keys.get(key)
-        if cached is not None:
-            return cached
-        ak = self._access_keys().get(key)
+        if cached is not None \
+                and now - cached[1] <= self.config.overrides_ttl_s:
+            return cached[0]
+        try:
+            ak = self._access_keys().get(key)
+        except HTTPError:
+            raise
+        except Exception as e:
+            if cached is not None:
+                # metadata store down mid-revalidation: keep serving a
+                # key that WAS valid rather than 500ing live traffic
+                return cached[0]
+            raise HTTPError(
+                503, f"access-key store unavailable: "
+                     f"{type(e).__name__}") from e
         if ak is None:
+            with self._lock:
+                self._keys.pop(key)       # revoked: stop serving NOW
             raise HTTPError(401, "Invalid accessKey.")
         label = self._app_label(ak.appid)
         ident = TenantIdentity(app_id=ak.appid, label=label)
         with self._lock:
-            self._keys.put(key, ident)
+            self._keys.put(key, (ident, now))
         return ident
 
-    @staticmethod
-    def _parse_header(value: str) -> Optional[TenantIdentity]:
-        appid, sep, label = value.partition(":")
-        if not sep or not label:
+    def signed_header(self, tenant: TenantIdentity) -> str:
+        """The X-PIO-App value a router asserts to its replicas:
+        `appid:label:hmac` keyed on the fleet's shared header_key."""
+        payload = tenant.header_value()
+        key = self.config.header_key
+        if not key:
+            # unsigned assertion; a verifying replica refuses it and
+            # the forwarded accessKey re-authenticates instead
+            return payload
+        sig = hmac.new(key.encode(), payload.encode(),
+                       hashlib.sha256).hexdigest()
+        return f"{payload}:{sig}"
+
+    def _parse_header(self, value: str) -> Optional[TenantIdentity]:
+        """Verify + parse a fleet identity assertion. None (-> fall
+        back to key auth) unless the HMAC checks out against the
+        shared header_key and the label is metrics-safe."""
+        key = self.config.header_key
+        if not key:
+            if not self._warned_no_header_key:
+                self._warned_no_header_key = True
+                _log.warning(
+                    "tenant_header_refused_no_key",
+                    detail="trust_header set but no header_key; set "
+                           "PIO_SERVER_ACCESS_KEY on every fleet host "
+                           "so replicas can verify X-PIO-App")
+            return None
+        payload, sep, sig = value.rpartition(":")
+        if not sep:
+            return None
+        expect = hmac.new(key.encode(), payload.encode(),
+                          hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(sig, expect):
+            return None
+        appid, sep, label = payload.partition(":")
+        if not sep or not _LABEL_RE.fullmatch(label):
             return None
         try:
             app_id = int(appid)
@@ -315,42 +414,51 @@ class AdmissionController:
         return row.merged_over(default)
 
     def _state(self, tenant: TenantIdentity) -> _TenantState:
-        """The tenant's admission state, created or TTL-refreshed under
-        the controller lock."""
-        st = self._tenants.get(tenant.label)
-        if st is None:
-            quota = self._load_quota(tenant)
-            st = _TenantState(
-                quota=quota,
-                bucket=_TokenBucket(quota.rate, quota.burst))
-            self._tenants.put(tenant.label, st)
-            self._tenant_gauge.set(float(len(self._tenants)))
-        elif (time.monotonic() - st.quota_loaded
-                > self.config.overrides_ttl_s):
-            quota = self._load_quota(tenant)
+        """The tenant's admission state, created or TTL-refreshed.
+        Quota DAO reads run OUTSIDE the controller lock — one slow
+        metadata-store read must not stall admission for every other
+        tenant — and the result lands under the lock with a
+        double-check (a racing refresher's write is equivalent)."""
+        with self._lock:
+            st = self._tenants.get(tenant.label)
+            if st is not None and (time.monotonic() - st.quota_loaded
+                                   <= self.config.overrides_ttl_s):
+                return st
+        quota = self._load_quota(tenant)     # no lock held
+        with self._lock:
+            st = self._tenants.get(tenant.label)
+            if st is None:
+                st = _TenantState(
+                    quota=quota,
+                    bucket=_TokenBucket(quota.rate, quota.burst))
+                self._tenants.put(tenant.label, st)
+                self._tenant_gauge.set(float(len(self._tenants)))
+                return st
             if quota != st.quota:
                 st.bucket.rate = max(quota.rate or 0.0, 0.0)
                 st.bucket.burst = max(quota.burst or 1.0, 1.0)
             st.quota = quota
             st.quota_loaded = time.monotonic()
-        return st
+            return st
 
     def quota(self, tenant: TenantIdentity) -> TenantQuota:
         """The tenant's effective quota (defaults merged with any
         stored override), from the TTL cache."""
-        with self._lock:
-            return self._state(tenant).quota
+        return self._state(tenant).quota
 
     def batch_params(self, tenant: Optional[TenantIdentity]
                      ) -> Tuple[str, float, int]:
         """(label, DRR weight, per-tenant queue cap) for the
-        micro-batcher submit."""
+        micro-batcher submit. An EXPLICIT 0 override keeps its
+        documented meaning (queue_max 0 = uncapped lane) — only None
+        inherits the server-wide default, same as concurrency."""
         if tenant is None or not self.config.enabled:
             return DEFAULT_TENANT, 1.0, 0
-        with self._lock:
-            q = self._state(tenant).quota
-        return (tenant.label, q.weight or 1.0,
-                int(q.queue_max or self.config.queue_max))
+        q = self._state(tenant).quota
+        weight = q.weight if q.weight is not None else self.config.weight
+        queue_max = (q.queue_max if q.queue_max is not None
+                     else self.config.queue_max)
+        return tenant.label, float(weight), int(queue_max)
 
     # -- admission -----------------------------------------------------------
     def admit(self, tenant: Optional[TenantIdentity]) -> "_AdmitGuard":
@@ -361,8 +469,8 @@ class AdmissionController:
         if tenant is None or tenant.pre_admitted \
                 or not self.config.enabled:
             return _AdmitGuard(self, None)
+        st = self._state(tenant)             # may read the DAO, no lock
         with self._lock:
-            st = self._state(tenant)
             wait = st.bucket.try_take()
             if wait > 0.0:
                 self._shed.labels(surface="quota",
@@ -381,29 +489,31 @@ class AdmissionController:
                     retry_after=0.05, status=429)
             st.inflight += 1
         self._admitted.labels(app=tenant.label).inc()
-        return _AdmitGuard(self, tenant)
+        return _AdmitGuard(self, st)
 
-    def _release(self, tenant: TenantIdentity) -> None:
+    def _release(self, st: _TenantState) -> None:
+        # decrement the EXACT state object admit() charged — a label
+        # lookup could hit a recreated state after LRU churn and leak
+        # the slot this request actually holds
         with self._lock:
-            st = self._tenants.get(tenant.label)
-            if st is not None and st.inflight > 0:
+            if st.inflight > 0:
                 st.inflight -= 1
 
 
 class _AdmitGuard:
     """Releases the concurrency slot admit() took; `with` scoped."""
 
-    __slots__ = ("_ctl", "_tenant")
+    __slots__ = ("_ctl", "_state")
 
     def __init__(self, ctl: AdmissionController,
-                 tenant: Optional[TenantIdentity]):
+                 state: "Optional[_TenantState]"):
         self._ctl = ctl
-        self._tenant = tenant
+        self._state = state
 
     def __enter__(self) -> "_AdmitGuard":
         return self
 
     def __exit__(self, *exc) -> bool:
-        if self._tenant is not None:
-            self._ctl._release(self._tenant)
+        if self._state is not None:
+            self._ctl._release(self._state)
         return False

@@ -37,6 +37,7 @@ from predictionio_tpu.tenancy import (
     DRRQueue, TenancyConfig, TenantIdentity,
 )
 from predictionio_tpu.tenancy.admission import _TokenBucket
+from predictionio_tpu.utils.http import HTTPError, Request
 
 VICTIM_KEY = "SKEY"
 AGGRO_KEY = "AKEY"
@@ -140,6 +141,20 @@ class TestBoundedTenantMap:
         m.put("c", 3)                  # evicts "b", the stalest
         assert "a" in m and "c" in m and "b" not in m
         assert len(m) == 2
+
+    def test_unevictable_entries_survive_cap(self):
+        m = BoundedTenantMap(1, evictable=lambda v: v != "pinned")
+        m.put("a", "pinned")
+        m.put("b", "x")                # nothing evictable but "b" is
+        assert "a" in m and "b" in m   # transient overflow, not loss
+        m.put("c", "y")                # "b" evictable -> dropped
+        assert "a" in m and "c" in m and "b" not in m
+
+    def test_pop_drops_entry(self):
+        m = BoundedTenantMap(2)
+        m.put("a", 1)
+        assert m.pop("a") == 1
+        assert m.pop("a") is None and "a" not in m
 
 
 class TestDRRQueue:
@@ -355,13 +370,94 @@ class TestAdmissionController:
         # tenancy off / anonymous -> the default FIFO lane, uncapped
         assert ctl.batch_params(None) == (DEFAULT_TENANT, 1.0, 0)
 
-    def test_header_parse_roundtrip(self):
+    def test_batch_params_explicit_zero_override_kept(self, mem_registry):
+        """queue_max=0 documents 'uncapped' — an explicit 0 override
+        must not silently inherit the server default."""
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "zapp"))
+        mem_registry.get_meta_data_tenant_quotas().upsert(
+            TenantQuota(appid=app_id, queue_max=0))
+        ctl = self._ctl(registry=mem_registry, queue_max=64)
+        _, weight, qmax = ctl.batch_params(
+            TenantIdentity(app_id=app_id, label="zapp"))
+        assert qmax == 0 and weight == 1.0
+
+    def _key_request(self, key):
+        return Request("POST", "/queries.json", {"accessKey": key}, {}, b"")
+
+    def test_revoked_key_stops_serving_after_ttl(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "revapp"))
+        keys = mem_registry.get_meta_data_access_keys()
+        keys.insert(AccessKey("REVKEY", app_id, ()))
+        # ttl 0 forces revalidation on every resolve
+        ctl = self._ctl(registry=mem_registry, overrides_ttl_s=0.0)
+        assert ctl.resolve(self._key_request("REVKEY")).label == "revapp"
+        keys.delete("REVKEY")
+        with pytest.raises(HTTPError) as ei:
+            ctl.resolve(self._key_request("REVKEY"))
+        assert ei.value.status == 401
+        # ...and the cache entry is gone, not just bypassed
+        with pytest.raises(HTTPError):
+            ctl.resolve(self._key_request("REVKEY"))
+
+    def test_key_cache_serves_within_ttl(self, mem_registry):
+        app_id = mem_registry.get_meta_data_apps().insert(App(0, "ttlapp"))
+        keys = mem_registry.get_meta_data_access_keys()
+        keys.insert(AccessKey("TTLKEY", app_id, ()))
+        ctl = self._ctl(registry=mem_registry, overrides_ttl_s=60.0)
+        assert ctl.resolve(self._key_request("TTLKEY")).label == "ttlapp"
+        keys.delete("TTLKEY")
+        # inside the TTL the cached positive entry still serves (one
+        # bounded staleness window, same contract as quota overrides)
+        assert ctl.resolve(self._key_request("TTLKEY")).label == "ttlapp"
+
+    def test_inflight_state_pinned_against_eviction(self):
+        """LRU churn must not leak concurrency slots: a state with
+        requests in flight stays live, and release hits the exact
+        state admit() charged."""
+        ctl = self._ctl(rate=1e6, burst=1e6, concurrency=1,
+                        max_tenants=1)
+        a = TenantIdentity(app_id=1, label="pin-a")
+        b = TenantIdentity(app_id=2, label="pin-b")
+        guard = ctl.admit(a)           # a: inflight 1, pinned
+        with ctl.admit(b):             # cap-1 map: would evict a
+            pass
+        with pytest.raises(OverloadedError):
+            ctl.admit(a)               # same state still enforcing cap
+        guard.__exit__(None, None, None)
+        with ctl.admit(a):             # slot really released
+            pass
+
+    def test_header_sign_verify_roundtrip(self):
+        ctl = self._ctl(trust_header=True, header_key="fleet-secret")
         ident = TenantIdentity(app_id=7, label="servapp")
-        parsed = AdmissionController._parse_header(ident.header_value())
+        parsed = ctl._parse_header(ctl.signed_header(ident))
         assert parsed.app_id == 7 and parsed.label == "servapp"
         assert parsed.pre_admitted
-        assert AdmissionController._parse_header("garbage") is None
-        assert AdmissionController._parse_header("x:y") is None
+
+    def test_header_forgeries_refused(self):
+        ctl = self._ctl(trust_header=True, header_key="fleet-secret")
+        ident = TenantIdentity(app_id=7, label="servapp")
+        signed = ctl.signed_header(ident)
+        # unsigned, tampered, cross-key, and garbage all fall through
+        # to key auth instead of minting an identity
+        assert ctl._parse_header("7:servapp") is None
+        tampered = signed[:-1] + ("0" if signed[-1] != "0" else "1")
+        assert ctl._parse_header(tampered) is None
+        other = self._ctl(trust_header=True, header_key="other-secret")
+        assert other._parse_header(signed) is None
+        assert ctl._parse_header("garbage") is None
+        assert ctl._parse_header("x:y") is None
+
+    def test_header_refused_without_key_or_bad_label(self):
+        # no shared key: NOTHING is honored (refuse-by-default), even a
+        # well-formed assertion
+        bare = self._ctl(trust_header=True)
+        assert bare._parse_header("7:servapp") is None
+        # metrics-hostile labels are refused even correctly signed —
+        # attacker-chosen label values must not hit counter cardinality
+        ctl = self._ctl(trust_header=True, header_key="fleet-secret")
+        evil = TenantIdentity(app_id=7, label="x" * 200)
+        assert ctl._parse_header(ctl.signed_header(evil)) is None
 
 
 # -- micro-batcher: deadline_batch + autotune ---------------------------------
@@ -396,6 +492,26 @@ class TestDeadlineBatchAdmission:
             b._drain_ewma = 0.01
         assert b.submit(_StubDep(), 3,
                         deadline=Deadline.after_s(5.0)) == 3
+
+    def test_stale_drain_estimate_decays_and_readmits(self):
+        """A one-off stall must not poison the deadline check into a
+        self-sustaining outage: with every deadlined request shed
+        BEFORE enqueue, no batch would ever drain to correct the
+        EWMA — the estimate has to age toward zero on the wall clock."""
+        b = _MicroBatcher(0.05, 8, submit_timeout_s=1.0)
+        with b._lock:
+            b._drain_ewma = 30.0                 # poisoned by one stall
+            b._drain_t = time.perf_counter() - 3600.0
+        assert b.drain_time_ewma() < 0.05        # aged toward zero
+        # the decayed estimate admits again; the drain then re-learns
+        assert b.submit(_StubDep(), 7, deadline=Deadline.after_s(0.5)) == 7
+        assert b.drain_time_ewma() < 1.0         # recovery, not 30s blend
+
+    def test_recent_drain_estimate_does_not_decay(self):
+        b = _MicroBatcher(0.05, 8, submit_timeout_s=1.0)
+        with b._lock:
+            b._drain_ewma = 0.2                  # fresh _drain_t: no aging
+        assert abs(b.drain_time_ewma() - 0.2) < 1e-9
 
 
 class TestWarmBucketAutotune:
@@ -619,14 +735,22 @@ class TestFleetTenancy:
             # quota charged exactly ONCE (leader), not again per replica
             assert _metric("pio_tenant_admitted_total",
                            app="servapp") == admitted0 + 1
-            # replicas run trust_header: the forwarded header IS the
-            # identity, so direct traffic with it serves without a key
+            # replicas run trust_header: the router's HMAC-SIGNED
+            # header IS the identity, no key needed
             rep = fleet._replicas[0]
+            signed = fleet.admission.signed_header(
+                TenantIdentity(app_id=1, label="servapp"))
             status, body, _ = call(
                 rep.port, "POST", "/queries.json", {"user": "u1", "num": 2},
-                headers={TENANT_HEADER: "1:servapp"})
+                headers={TENANT_HEADER: signed})
             assert status == 200
-            # ...but direct traffic with NO credentials still 401s
+            # ...but an UNSIGNED assertion is a forgery — refused, and
+            # with no valid key behind it the request 401s
+            status, _, _ = call(
+                rep.port, "POST", "/queries.json", {"user": "u1", "num": 2},
+                headers={TENANT_HEADER: "1:servapp"})
+            assert status == 401
+            # ...and direct traffic with NO credentials still 401s
             status, _, _ = call(rep.port, "POST", "/queries.json",
                                 {"user": "u1", "num": 2})
             assert status == 401
