@@ -796,6 +796,115 @@ def _qps_hammer(server, label, n_users, base_qps):
     emit(f"serve_queries_json_qps_{label}", qps, "qps", qps / base_qps)
 
 
+def bench_wire(u, i, r, n_users, n_items):
+    """Wire-path microbench (the 10k-qps PR's three layers in
+    isolation): compiled-shape parse vs json.loads per query, the
+    vectorized batch encoder vs per-result json.dumps per response, and
+    live /queries.json throughput over persistent keep-alive
+    connections vs a fresh TCP dial per request."""
+    import dataclasses as _dc
+    import http.client as _hc
+
+    from predictionio_tpu.serving.server import (
+        _FAST_QUERY_RE, _encode_scores_batch, to_jsonable)
+
+    # parse ns/query: the compiled shape match against the generic
+    # parser it replaces, on the exact body the fast path serves
+    body = b'{"user": "u4711", "num": 10}'
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m = _FAST_QUERY_RE.match(body)
+    fast_ns = (time.perf_counter() - t0) / n * 1e9
+    if m is None or m.group(1) != b"u4711":
+        raise SystemExit("wire parse bench: fast path missed its shape")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        json.loads(body)
+    loads_ns = (time.perf_counter() - t0) / n * 1e9
+    emit("wire_parse_fast_ns", fast_ns, "ns_per_query",
+         loads_ns / fast_ns)
+    emit("wire_parse_json_ns", loads_ns, "ns_per_query", 1.0)
+
+    # encode ns/response: one drained batch through the vectorized
+    # splicer vs the to_jsonable + json.dumps path it replaces
+    @_dc.dataclass
+    class _Score:
+        item: str
+        score: float
+
+    @_dc.dataclass
+    class _Result:
+        itemScores: list
+
+    batch = [_Result([_Score(f"i{j}", 0.125 * j + q)
+                      for j in range(10)]) for q in range(64)]
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        wires = _encode_scores_batch(None, batch)
+    enc_ns = (time.perf_counter() - t0) / (reps * len(batch)) * 1e9
+    if wires is None or json.loads(wires[3]) != {
+            "itemScores": [{"item": s.item, "score": s.score}
+                           for s in batch[3].itemScores]}:
+        raise SystemExit("wire encode bench: splicer output mismatch")
+    # the generic route this replaced: to_jsonable's recursive
+    # dataclass walk + one json.dumps per response
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for res in batch:
+            json.dumps(to_jsonable(res)).encode()
+    dumps_ns = (time.perf_counter() - t0) / (reps * len(batch)) * 1e9
+    emit("wire_encode_batch_ns", enc_ns, "ns_per_response",
+         dumps_ns / enc_ns)
+    emit("wire_encode_json_ns", dumps_ns, "ns_per_response", 1.0)
+
+    # connection-reuse qps: the selector front end's persistent
+    # keep-alive path vs a fresh dial per request (the old stack's
+    # effective behavior under urllib)
+    server, _registry, _engine = _deploy_server(u, i, r, n_users, n_items)
+    payloads = [json.dumps({"user": f"u{q % n_users}", "num": 10}).encode()
+                for q in range(256)]
+    n_threads, per_thread = 8, 150
+
+    def _hammer(reuse):
+        conns = {}
+
+        def req(i):
+            tid = i // per_thread
+            c = conns.get(tid) if reuse else None
+            if c is None:
+                c = _hc.HTTPConnection("127.0.0.1", server.port,
+                                       timeout=30)
+                if reuse:
+                    conns[tid] = c
+            c.request("POST", "/queries.json",
+                      body=payloads[i % len(payloads)],
+                      headers={"Content-Type": "application/json"})
+            resp = c.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"status {resp.status}")
+            if not reuse:
+                c.close()
+
+        dt = _fanout(req, n_threads, per_thread)
+        for c in conns.values():
+            c.close()
+        return n_threads * per_thread / dt
+
+    try:
+        for q in range(20):
+            _post(server.port, {"user": f"u{q}", "num": 10})   # warm
+        fresh_qps = _hammer(False)
+        reuse_qps = _hammer(True)
+    finally:
+        server.shutdown()
+    emit("wire_fresh_dial_qps", fresh_qps, "qps", 1.0)
+    emit("wire_keepalive_qps", reuse_qps, "qps",
+         reuse_qps / fresh_qps)
+
+
 def bench_serving(u, i, r, n_users, n_items):
     from predictionio_tpu.serving import PredictionServer, ServerConfig
 
@@ -2736,6 +2845,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_tenancy, u, i, r, n_users, n_items)
         return
+    if "--only-wire" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_wire, u, i, r, n_users, n_items)
+        return
     if "--only-configs" in sys.argv:   # BASELINE configs 2-5 + seqrec
         section(bench_classification)
         section(bench_similarproduct)
@@ -2762,6 +2875,7 @@ def main():
         section(bench_twotower)
         section(bench_seqrec)
         section(bench_serving, u, i, r, n_users, n_items)
+        section(bench_wire, u, i, r, n_users, n_items)
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)

@@ -145,9 +145,12 @@ class ALSAlgorithm(Algorithm):
             raise ValueError(
                 "No rating events found; check appName and event import "
                 "(parity: ALSAlgorithm.scala:56-61 require non-empty)")
+        # timings= feeds solver phases + solver_residual into the phase
+        # report, arming the bench's convergence gate
         x, y = als.als_train(
             pd, rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
-            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh,
+            timings=ctx.phase_timings)
         return als.ALSModel(x, y, pd.users, pd.items)
 
     def predict(self, model: als.ALSModel, query: Query) -> PredictedResult:

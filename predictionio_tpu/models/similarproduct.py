@@ -240,7 +240,8 @@ class ALSAlgorithm(_FactorSimilarityAlgorithm):
         x, y = als.als_train(
             pd.views, rank=p.rank, iterations=p.num_iterations,
             reg=p.lambda_, implicit=True, alpha=p.alpha,
-            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh,
+            timings=ctx.phase_timings)
         return SimilarModel(y, pd.views.items, pd.item_categories,
                             user_factors=x, users=pd.views.users)
 
@@ -266,7 +267,8 @@ class LikeAlgorithm(_FactorSimilarityAlgorithm):
         x, y = als.als_train(
             pd.likes, rank=p.rank, iterations=p.num_iterations,
             reg=p.lambda_, implicit=True, alpha=p.alpha,
-            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh)
+            seed=p.seed if p.seed is not None else 0, mesh=ctx.mesh,
+            timings=ctx.phase_timings)
         return SimilarModel(y, pd.likes.items, pd.item_categories,
                             user_factors=x, users=pd.likes.users)
 

@@ -742,10 +742,22 @@ def init_factors(n_users: int, n_items: int, rank: int, seed: int,
     (`ops.oracle`) can start from identical factors for parity checks."""
     key = jax.random.PRNGKey(seed)
     ku, ki = jax.random.split(key)
-    x = np.abs(np.asarray(jax.random.normal(
-        ku, (max(n_users, 1), rank)))) / math.sqrt(rank)
-    y = np.abs(np.asarray(jax.random.normal(
-        ki, (max(n_items, 1), rank)))) / math.sqrt(rank)
+
+    def _rowkeyed(side_key, n_rows):
+        # per-row keyed draws: row r depends only on (seed, r), NOT on
+        # the matrix height — threefry bit generation pairs counter
+        # halves across the whole block, so a single (n, rank) draw
+        # gives row r different values at different n. Shape-stable
+        # rows mean a catalog padded with never-rated (zeroed) tail
+        # rows starts — and therefore trains — identically to one
+        # without them (the phantom-item invariance the tests pin).
+        rows = np.arange(max(n_rows, 1))
+        block = jax.vmap(lambda r: jax.random.normal(
+            jax.random.fold_in(side_key, r), (rank,)))(rows)
+        return np.abs(np.asarray(block))
+
+    x = _rowkeyed(ku, n_users) / math.sqrt(rank)
+    y = _rowkeyed(ki, n_items) / math.sqrt(rank)
     if user_present is not None:
         x = np.where(user_present[:, None], x, 0.0)
     if item_present is not None:
@@ -911,7 +923,12 @@ def _check_residual(res: float, timings: Optional[dict]) -> None:
     converge — the exact-Cholesky reference (MLlib CholeskySolver) has
     no such failure mode, so silence here would be a parity trap."""
     if timings is not None:
-        timings["solver_residual"] = res
+        # keep the WORST residual across a run's solves (two-sided
+        # similar-product trains solve twice into one phase dict): the
+        # bench convergence gate must see any failed solve, not just
+        # the last one
+        timings["solver_residual"] = max(
+            res, timings.get("solver_residual", 0.0))
     if res > 1e-2:
         import logging
         logging.getLogger(__name__).warning(

@@ -1,0 +1,286 @@
+"""Single-launch fused serve kernel: gather -> matmul -> ban-mask -> top-k.
+
+The AOT serving plans in `ops/topk.py` run the banned-index hot path as
+an XLA chain: a full [b, n_items] score matrix is materialized in HBM,
+a scatter stamps NEG_INF over the banned columns, and `lax.top_k` sorts
+every row. At serve batch sizes the matmul itself is microseconds — the
+cost is the HBM round trip of the score matrix plus the multi-kernel
+launch train. This module collapses the whole chain into ONE Pallas
+launch per batch bucket:
+
+  - the item catalog streams through VMEM in `PIO_FUSED_TILE_ITEMS`-row
+    tiles (grid over item tiles; the full score matrix never exists in
+    HBM);
+  - each tile's scores are computed on the MXU
+    (`preferred_element_type=f32`, `Precision.HIGHEST` — identical math
+    to the XLA chain), banned GLOBAL ids are masked by comparison
+    against the tile's id range (the `n_items` filler never matches a
+    real id), catalog-padding rows are masked to NEG_INF;
+  - a running [b, k] (score, id) scoreboard carried in the output
+    blocks merges each tile via k selection steps with an explicit
+    (max score, lowest id) key — exactly `lax.top_k`'s documented
+    lowest-index-first tie-break, so the fused outputs are
+    BIT-IDENTICAL to the `_topk_scores_banned` oracle whenever the
+    per-cell dot products are (always true for the integer-valued
+    factors the parity tests use; real factors agree to the last ulp
+    of the two matmuls). Removed scoreboard entries are parked at
+    -inf, strictly below the NEG_INF ban value, so a banned item can
+    be emitted (matching the oracle) but never emitted twice.
+
+Availability is gated by `PIO_SERVE_FUSED`:
+
+  auto  (default) fuse only on TPU backends — Mosaic is the target;
+                  CPU/GPU keep the proven XLA chain;
+  on              fuse everywhere; non-TPU backends run the kernel in
+                  Pallas interpret mode (traced to plain XLA ops — the
+                  parity tests exercise exactly this);
+  off             never fuse.
+
+Every builder is fallible by design: `maybe_build_bucket` /
+`shard_local_candidates` return None (and `BucketedTopK.warm` /
+`ShardedBucketedTopK` fall back to the AOT XLA chain) when fusion is
+off or the kernel fails to lower on this backend. The compiled
+executable keeps the exact `(vecs, factors, banned)` positional
+signature of the chain it replaces, so `swap_factors` hot-swaps and the
+zero-recompile steady state are preserved unchanged; off-CPU the
+per-call query and banned blocks are donated exactly as before.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU memory-space enum; absent on exotic builds — SMEM scalar
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - pallas.tpu ships with jax
+    pltpu = None
+
+from predictionio_tpu.ops.topk import NEG_INF
+
+log = logging.getLogger("pio.ops.fused")
+
+# items per VMEM tile (clamped up to k so every merge sees >= k real
+# candidates and the scoreboard fillers can never leak into results)
+DEFAULT_TILE_ITEMS = 512
+
+# scoreboard sentinels: removed entries park BELOW the NEG_INF ban
+# value so they are never re-picked; filler ids park ABOVE every real
+# id so the lowest-id tie-break prefers any real item
+_REMOVED = np.float32(-np.inf)
+_FILLER_ID = np.int32(2**31 - 1)
+
+
+def fused_mode() -> str:
+    """Normalized PIO_SERVE_FUSED: "auto" | "on" | "off"."""
+    raw = (os.environ.get("PIO_SERVE_FUSED", "auto") or "auto").lower()
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw in ("on", "1", "true", "yes"):
+        return "on"
+    return "auto"
+
+
+def fused_wanted() -> bool:
+    """Whether serve plans should attempt the fused kernel at warmup."""
+    mode = fused_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode (kernel traced to plain XLA) everywhere
+    except real TPU backends, where Mosaic compiles it natively."""
+    return jax.default_backend() != "tpu"
+
+
+def _tile_items(k: int) -> int:
+    tile = int(os.environ.get("PIO_FUSED_TILE_ITEMS", "0") or 0)
+    if tile <= 0:
+        tile = DEFAULT_TILE_ITEMS
+    return max(tile, k)
+
+
+def _merge_body(n_valid, t, vecs_ref, fac_ref, ban_ref,
+                out_s_ref, out_i_ref, *, k: int, tile: int,
+                n_banned: int) -> None:
+    """One grid step: score this item tile, mask bans/padding, merge
+    into the running scoreboard carried by the output blocks."""
+    b = vecs_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _init():
+        out_s_ref[...] = jnp.full((b, k), _REMOVED, jnp.float32)
+        out_i_ref[...] = jnp.full((b, k), _FILLER_ID, jnp.int32)
+
+    # [b, tile] tile scores — same contraction/precision as the chain
+    scores = jax.lax.dot_general(
+        vecs_ref[...], fac_ref[...], (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    gidx = t * tile + jax.lax.broadcasted_iota(jnp.int32, (b, tile), 1)
+    scores = jnp.where(gidx < n_valid, scores, np.float32(NEG_INF))
+
+    ban = ban_ref[...]
+
+    def ban_body(w, sc):
+        col = jax.lax.dynamic_slice_in_dim(ban, w, 1, axis=1)  # [b,1]
+        return jnp.where(col == gidx, np.float32(NEG_INF), sc)
+
+    scores = jax.lax.fori_loop(0, n_banned, ban_body, scores)
+
+    # k-step selection over scoreboard + tile with the explicit
+    # (max score, lowest id) key of lax.top_k
+    comb_s = jnp.concatenate([out_s_ref[...], scores], axis=1)
+    comb_i = jnp.concatenate([out_i_ref[...], gidx], axis=1)
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
+
+    def step(j, carry):
+        cs, outs, outi = carry
+        m = jnp.max(cs, axis=1, keepdims=True)
+        is_m = cs == m
+        pick = jnp.min(jnp.where(is_m, comb_i, _FILLER_ID),
+                       axis=1, keepdims=True)
+        cs = jnp.where(is_m & (comb_i == pick), _REMOVED, cs)
+        outs = jnp.where(kcol == j, m, outs)
+        outi = jnp.where(kcol == j, pick, outi)
+        return cs, outs, outi
+
+    _, outs, outi = jax.lax.fori_loop(
+        0, k, step, (comb_s,
+                     jnp.zeros((b, k), jnp.float32),
+                     jnp.zeros((b, k), jnp.int32)))
+    out_s_ref[...] = outs
+    out_i_ref[...] = outi
+
+
+def _kernel_static(vecs_ref, fac_ref, ban_ref, out_s_ref, out_i_ref, *,
+                   n_valid: int, k: int, tile: int,
+                   n_banned: int) -> None:
+    """Single-device form: the valid-row bound is the static catalog
+    size baked into the trace."""
+    _merge_body(n_valid, pl.program_id(0), vecs_ref, fac_ref, ban_ref,
+                out_s_ref, out_i_ref, k=k, tile=tile, n_banned=n_banned)
+
+
+def _kernel_dynamic(nv_ref, vecs_ref, fac_ref, ban_ref, out_s_ref,
+                    out_i_ref, *, k: int, tile: int,
+                    n_banned: int) -> None:
+    """Sharded form: each shard's valid-row bound depends on its mesh
+    position, so it arrives as a scalar operand (SMEM on TPU)."""
+    _merge_body(nv_ref[0], pl.program_id(0), vecs_ref, fac_ref, ban_ref,
+                out_s_ref, out_i_ref, k=k, tile=tile, n_banned=n_banned)
+
+
+def _pallas_topk(n_rows: int, rank: int, *, k: int, bucket: int,
+                 banned_width: int, n_valid: Optional[int],
+                 interpret: bool):
+    """The raw fused callable for one bucket. With `n_valid` set the
+    bound is static (single-device); with `n_valid=None` the callable
+    takes a leading [1] int32 bound operand (per-shard form)."""
+    tile = _tile_items(k)
+    nt = -(-n_rows // tile)
+    specs = [pl.BlockSpec((bucket, rank), lambda i: (0, 0)),
+             pl.BlockSpec((tile, rank), lambda i: (i, 0)),
+             pl.BlockSpec((bucket, banned_width), lambda i: (0, 0))]
+    if n_valid is None:
+        kern = functools.partial(_kernel_dynamic, k=k, tile=tile,
+                                 n_banned=banned_width)
+        smem = (pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.SMEM)
+                if (pltpu is not None and not interpret)
+                else pl.BlockSpec(memory_space=None))
+        specs = [smem] + specs
+    else:
+        kern = functools.partial(_kernel_static, n_valid=n_valid, k=k,
+                                 tile=tile, n_banned=banned_width)
+    return pl.pallas_call(
+        kern,
+        grid=(nt,),
+        in_specs=specs,
+        out_specs=(pl.BlockSpec((bucket, k), lambda i: (0, 0)),
+                   pl.BlockSpec((bucket, k), lambda i: (0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((bucket, k), jnp.float32),
+                   jax.ShapeDtypeStruct((bucket, k), jnp.int32)),
+        interpret=interpret)
+
+
+def build_fused_topk(factors, *, n_items: int, rank: int, k: int,
+                     bucket: int, banned_width: int,
+                     interpret: Optional[bool] = None,
+                     donate: Optional[bool] = None):
+    """AOT-lower/compile the fused executable for one batch bucket
+    against the resident `factors`. The compiled signature is
+    `(vecs [bucket, rank] f32, factors, banned [bucket, W] i32)` —
+    positionally identical to the XLA chain it replaces, so
+    `swap_factors` keeps working with zero recompiles. Raises on
+    backends that cannot lower the kernel (callers fall back)."""
+    if interpret is None:
+        interpret = _interpret()
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    call = _pallas_topk(n_items, rank, k=k, bucket=bucket,
+                        banned_width=banned_width, n_valid=n_items,
+                        interpret=interpret)
+    fn = jax.jit(call, donate_argnums=(0, 2)) if donate else jax.jit(call)
+    vec_spec = jax.ShapeDtypeStruct((bucket, rank), np.float32)
+    ban_spec = jax.ShapeDtypeStruct((bucket, banned_width), np.int32)
+    return fn.lower(vec_spec, factors, ban_spec).compile()
+
+
+_WARNED = False
+
+
+def _warn_once(exc: Exception) -> None:
+    global _WARNED
+    if not _WARNED:
+        _WARNED = True
+        log.warning("fused serve kernel unavailable on backend %r "
+                    "(falling back to the XLA chain): %s",
+                    jax.default_backend(), exc)
+
+
+def maybe_build_bucket(factors, *, n_items: int, rank: int, k: int,
+                       bucket: int, banned_width: int):
+    """`build_fused_topk` behind the PIO_SERVE_FUSED gate: None when
+    fusion is off for this backend or the kernel fails to lower — the
+    caller keeps the AOT XLA chain for that bucket."""
+    if not fused_wanted():
+        return None
+    try:
+        return build_fused_topk(factors, n_items=n_items, rank=rank,
+                                k=k, bucket=bucket,
+                                banned_width=banned_width)
+    except Exception as exc:  # lowering/compile failure -> XLA chain
+        _warn_once(exc)
+        return None
+
+
+def shard_local_candidates(per_shard: int, rank: int, *, k: int,
+                           bucket: int, banned_width: int):
+    """The per-shard fused local-candidate program for
+    `ShardedBucketedTopK`: `(n_valid [1] i32, vecs, factors_local
+    [per_shard, rank], banned_local [bucket, W] i32) -> (scores
+    [bucket, k], LOCAL ids [bucket, k])`, for use inside shard_map
+    (ban translation to local ids and the allgather merge stay with
+    the caller). None when fusion is off; lowering failures surface
+    when the enclosing program compiles — the sharded plan catches
+    them and rebuilds unfused."""
+    if not fused_wanted():
+        return None
+    try:
+        return _pallas_topk(per_shard, rank, k=k, bucket=bucket,
+                            banned_width=banned_width, n_valid=None,
+                            interpret=_interpret())
+    except Exception as exc:
+        _warn_once(exc)
+        return None

@@ -80,6 +80,15 @@ DISPATCH_COUNTS = {"host": 0, "device": 0, "sharded": 0}
 PROMOTE_FLOOR_CELLS = int(_os.environ.get(
     "PIO_TOPK_PROMOTE_FLOOR_CELLS", 1 << 16))
 
+# Exploration cadence for the amortized policy: with no device
+# observation yet, every Nth promotable-sized problem is routed to the
+# device purely to SEED its latency EWMA. Without this the policy can
+# never promote (promotion needs a device EWMA, but sub-crossover
+# problems all go to the host, so the device EWMA is never observed —
+# the r05 ecommerce runs served 552 host calls and 0 device batches
+# exactly this way). 0 disables probing.
+EXPLORE_EVERY = int(_os.environ.get("PIO_TOPK_EXPLORE_EVERY", 32))
+
 _DISPATCH_TOTAL = None
 
 
@@ -131,14 +140,24 @@ class DispatchPolicy:
         # batch fits it (mirroring the single-device plan)
         self._sharded_call_s: Optional[float] = None
         self._host_inflight = 0
+        self._probe_tick = 0
 
     def choose(self, cells: int) -> str:
         if cells >= HOST_CROSSOVER_CELLS:
             return "device"
+        if cells < PROMOTE_FLOOR_CELLS:
+            # tiny problems are deterministically host — never probed
+            return "host"
         with self._lock:
             h, d = self._host_s_per_cell, self._device_call_s
             inflight = self._host_inflight
-        if h is None or d is None or cells < PROMOTE_FLOOR_CELLS:
+            if d is None and EXPLORE_EVERY > 0:
+                # no device observation yet: probe every Nth call so
+                # the EWMA gets seeded and promotion becomes reachable
+                self._probe_tick += 1
+                if self._probe_tick % EXPLORE_EVERY == 0:
+                    return "device"
+        if h is None or d is None:
             return "host"
         return "device" if d <= cells * h * (1.0 + inflight) else "host"
 
@@ -515,10 +534,21 @@ class BucketedTopK:
         self._host_factors = host
         self.factors = device_resident(host)
         self._exe: dict = {}
+        # buckets served by the single-launch fused kernel (see
+        # ops/fused_topk.py); the rest keep the XLA chain
+        self.fused_buckets = 0
 
     def warm(self) -> int:
         """AOT-lower/compile every bucket executable; returns how many
-        were compiled (idempotent: already-warm buckets are skipped)."""
+        were compiled (idempotent: already-warm buckets are skipped).
+
+        Each bucket first tries the single-launch fused kernel
+        (`ops/fused_topk.py`, gated by PIO_SERVE_FUSED) and falls back
+        to the AOT XLA chain when fusion is off or unsupported — both
+        compile to the same `(vecs, factors, banned)` signature, so
+        `swap_factors` and the zero-recompile contract hold either
+        way."""
+        from predictionio_tpu.ops import fused_topk
         fn = (_topk_scores_banned_device
               if jax.default_backend() == "cpu"
               else _topk_scores_banned_donated)
@@ -526,11 +556,19 @@ class BucketedTopK:
         for b in self.buckets:
             if b in self._exe:
                 continue
-            vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
-            ban_spec = jax.ShapeDtypeStruct((b, self.banned_width),
-                                            np.int32)
-            self._exe[b] = fn.lower(vec_spec, self.factors, ban_spec,
-                                    k=self.k, has_bans=True).compile()
+            exe = fused_topk.maybe_build_bucket(
+                self.factors, n_items=self.n_items, rank=self.rank,
+                k=self.k, bucket=b, banned_width=self.banned_width)
+            if exe is not None:
+                self.fused_buckets += 1
+            else:
+                vec_spec = jax.ShapeDtypeStruct((b, self.rank),
+                                                np.float32)
+                ban_spec = jax.ShapeDtypeStruct((b, self.banned_width),
+                                                np.int32)
+                exe = fn.lower(vec_spec, self.factors, ban_spec,
+                               k=self.k, has_bans=True).compile()
+            self._exe[b] = exe
             compiled += 1
         return compiled
 

@@ -259,20 +259,34 @@ class ShardedBucketedTopK(_ShardedPlanBase):
                  banned_width: int = 256, mesh=None):
         super().__init__(item_factors, k=k, buckets=buckets, mesh=mesh)
         self.banned_width = _next_pow2(max(1, banned_width))
+        # whether the per-shard local-candidate stage runs as the
+        # single-launch fused kernel (ops/fused_topk.py); flips back to
+        # False if the kernel fails to lower at warm() time
+        self.fused = False
         self._fn = self._build()
 
-    def _build(self):
+    def _build(self, bucket: Optional[int] = None):
         from jax.sharding import PartitionSpec as P
+        from predictionio_tpu.ops import fused_topk
         per, n_items, kk, k = (self.per_shard, self.n_items,
                                self.k_shard, self.k)
+
+        # the fused per-shard local-candidate kernel needs the batch
+        # bucket at build time (its grid is shape-specialized); the XLA
+        # body below shape-polymorphically covers every bucket
+        local = None
+        if bucket is not None:
+            local = fused_topk.shard_local_candidates(
+                per, self.rank, k=kk, bucket=bucket,
+                banned_width=self.banned_width)
+            if local is None:
+                return None
+            self.fused = True
 
         def body(vecs, factors_local, banned):
             # vecs [b, rank] + banned [b, W] replicated; factors_local
             # [per_shard, rank] is this shard's catalog slice
             base = jax.lax.axis_index(SHARD_AXIS) * per
-            scores = jnp.matmul(vecs, factors_local.T,
-                                precision=jax.lax.Precision.HIGHEST)
-            rows = jnp.arange(scores.shape[0])[:, None]
             # banned ids are GLOBAL: translate to this shard's local
             # columns. Out-of-shard ids (and the n_items filler) must be
             # routed to an explicitly out-of-bounds slot BEFORE the
@@ -281,10 +295,22 @@ class ShardedBucketedTopK(_ShardedPlanBase):
             # banned id g also ban g + per_shard on the next shard.
             loc = banned - base
             loc = jnp.where((loc >= 0) & (loc < per), loc, per)
-            scores = scores.at[rows, loc].set(NEG_INF, mode="drop")
-            gids = base + jnp.arange(per)
-            scores = jnp.where(gids[None, :] < n_items, scores, NEG_INF)
-            s, ix = jax.lax.top_k(scores, kk)
+            if local is not None:
+                # single launch: matmul + ban-mask + local top-k fused;
+                # the shard's valid-row bound is mesh-position-dependent
+                # and rides in as a scalar operand
+                nv = jnp.clip(n_items - base, 0,
+                              per).astype(jnp.int32).reshape((1,))
+                s, ix = local(nv, vecs, factors_local, loc)
+            else:
+                scores = jnp.matmul(vecs, factors_local.T,
+                                    precision=jax.lax.Precision.HIGHEST)
+                rows = jnp.arange(scores.shape[0])[:, None]
+                scores = scores.at[rows, loc].set(NEG_INF, mode="drop")
+                gids = base + jnp.arange(per)
+                scores = jnp.where(gids[None, :] < n_items, scores,
+                                   NEG_INF)
+                s, ix = jax.lax.top_k(scores, kk)
             s_all = jax.lax.all_gather(s, SHARD_AXIS)
             g_all = jax.lax.all_gather(ix + base, SHARD_AXIS)
             # shard-major concatenation = global-id order for ties
@@ -305,7 +331,9 @@ class ShardedBucketedTopK(_ShardedPlanBase):
 
     def warm(self) -> int:
         """AOT-lower/compile every bucket executable against the
-        resident sharded factors (idempotent)."""
+        resident sharded factors (idempotent). Each bucket tries the
+        fused per-shard kernel first (PIO_SERVE_FUSED gate) and falls
+        back to the XLA body when fusion is off or fails to lower."""
         compiled = 0
         for b in self.buckets:
             if b in self._exe:
@@ -313,8 +341,20 @@ class ShardedBucketedTopK(_ShardedPlanBase):
             vec_spec = jax.ShapeDtypeStruct((b, self.rank), np.float32)
             ban_spec = jax.ShapeDtypeStruct((b, self.banned_width),
                                             np.int32)
-            self._exe[b] = self._fn.lower(vec_spec, self.factors,
-                                          ban_spec).compile()
+            exe = None
+            fn = self._build(bucket=b)
+            if fn is not None:
+                try:
+                    exe = fn.lower(vec_spec, self.factors,
+                                   ban_spec).compile()
+                except Exception:
+                    # kernel lowered at trace time but died in the
+                    # backend compiler: unfuse and fall through
+                    self.fused = False
+            if exe is None:
+                exe = self._fn.lower(vec_spec, self.factors,
+                                     ban_spec).compile()
+            self._exe[b] = exe
             compiled += 1
         return compiled
 

@@ -28,11 +28,17 @@ import dataclasses
 import json
 import queue
 import random
+import re
 import string
 import threading
 import time
+import typing
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence
+from json.encoder import encode_basestring_ascii as _json_str
+from typing import Any, Callable, Dict, List, Optional, Sequence
+from urllib.parse import unquote_plus
+
+import numpy as np
 
 from predictionio_tpu.core import (
     RuntimeContext, WorkflowParams, extract_params,
@@ -41,19 +47,21 @@ from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
 from predictionio_tpu.resilience import (
-    Deadline, DeadlineExceeded, OverloadedError, RetryPolicy,
-    call_with_retry, current_deadline, faults,
+    DEADLINE_HEADER, CircuitOpenError, Deadline, DeadlineExceeded,
+    OverloadedError, RetryPolicy, call_with_retry, current_deadline,
+    deadline_from_header, faults,
 )
 from predictionio_tpu.serving.plugins import (
     EngineServerPluginContext, QueryInfo,
 )
 from predictionio_tpu.tenancy import (
-    DEFAULT_TENANT, AdmissionController, DRRQueue, TenancyConfig,
-    TenantIdentity,
+    DEFAULT_TENANT, TENANT_HEADER, AdmissionController, DRRQueue,
+    TenancyConfig, TenantIdentity,
 )
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
 )
+from predictionio_tpu.utils.wire import RawRequest, build_response
 
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                       256.0, 512.0)
@@ -211,6 +219,118 @@ def to_jsonable(obj: Any) -> Any:
     return obj
 
 
+# -- wire fast path ----------------------------------------------------------
+# The compiled query shape: exactly {"user": "<str>", "num": <int>} with
+# JSON's optional insignificant whitespace. Anything else — extra fields,
+# escapes in the user id, a numeric user, nested anything — falls through
+# to the generic json.loads route, which IS the fallback parser, so the
+# fast path never has to be complete, only correct on what it claims.
+_FAST_QUERY_RE = re.compile(
+    rb'\A[ \t\r\n]*\{[ \t\r\n]*"user"[ \t\r\n]*:[ \t\r\n]*'
+    rb'"([^"\\\x00-\x1f]{0,512})"[ \t\r\n]*,[ \t\r\n]*'
+    rb'"num"[ \t\r\n]*:[ \t\r\n]*(-?(?:0|[1-9]\d{0,8}))[ \t\r\n]*\}'
+    rb'[ \t\r\n]*\Z')
+# accessKey scanned straight out of the raw query string (the generic
+# path runs parse_qs over the whole thing)
+_ACCESS_KEY_RE = re.compile(r"(?:^|&)accessKey=([^&]*)")
+
+_EMPTY_SCORES = b'{"itemScores": []}'
+
+
+def _scan_access_key(qs: str) -> Optional[str]:
+    """parse_qs-equivalent extraction of the one parameter the serve
+    route reads; percent/plus decoding only when actually present."""
+    if "accessKey" not in qs:
+        return None
+    m = _ACCESS_KEY_RE.search(qs)
+    if m is None:
+        return None
+    v = m.group(1)
+    if "%" in v or "+" in v:
+        v = unquote_plus(v)
+    return v
+
+
+def _derive_fast_ctor(qc) -> Optional[Callable[[str, int], Any]]:
+    """A (user, num) -> Query constructor when — and only when — the
+    deployment's query class has a str `user` and an int `num` and every
+    other field defaults; else None and the fast path stays dark for
+    this deployment. Computed once per (re)load, never per request."""
+    if qc is None or not dataclasses.is_dataclass(qc):
+        return None
+    try:
+        hints = typing.get_type_hints(qc)
+    except Exception:
+        return None
+    if hints.get("user") is not str or hints.get("num") is not int:
+        return None
+    for f in dataclasses.fields(qc):
+        if f.name in ("user", "num"):
+            continue
+        if f.default is dataclasses.MISSING \
+                and f.default_factory is dataclasses.MISSING:
+            return None
+    try:
+        qc(user="", num=1)
+    except Exception:
+        return None
+    return lambda u, n: qc(user=u, num=n)
+
+
+# result type -> encodable? (a dataclass whose ONLY field is itemScores)
+_WIRE_RESULT_TYPES: Dict[type, bool] = {}
+
+
+def _wire_encodable(t: type) -> bool:
+    ok = _WIRE_RESULT_TYPES.get(t)
+    if ok is None:
+        ok = (dataclasses.is_dataclass(t)
+              and [f.name for f in dataclasses.fields(t)] == ["itemScores"])
+        _WIRE_RESULT_TYPES[t] = ok
+    return ok
+
+
+def _encode_scores_batch(dep, results: Sequence[Any]
+                         ) -> Optional[List[Optional[bytes]]]:
+    """Pre-serialized response fragments for one drained batch: every
+    score in the batch is formatted in ONE vectorized numpy pass
+    (%.12g — exact for float32 device scores, 12 significant digits for
+    host float64) and spliced between static envelope bytes; item ids go
+    through the C JSON string escaper. Returns one wire body per result,
+    or None when any result is not a bare itemScores record (the caller
+    then serves that batch through to_jsonable + json.dumps)."""
+    counts: List[int] = []
+    items: List[str] = []
+    scores: List[float] = []
+    for r in results:
+        if not _wire_encodable(type(r)):
+            return None
+        iss = r.itemScores
+        counts.append(len(iss))
+        for s in iss:
+            it = getattr(s, "item", None)
+            if type(it) is not str:
+                return None
+            items.append(it)
+            scores.append(s.score)
+    if scores:
+        txt = np.char.mod(
+            b"%.12g",
+            np.asarray(scores, np.float64))  # lint: ok (host floats)
+    out: List[Optional[bytes]] = []
+    pos = 0
+    for n in counts:
+        if n == 0:
+            out.append(_EMPTY_SCORES)
+            continue
+        frags = [b'{"item": ' + _json_str(items[j]).encode("utf-8")
+                 + b', "score": ' + bytes(txt[j]) + b'}'
+                 for j in range(pos, pos + n)]
+        pos += n
+        out.append(b'{"itemScores": [' + b", ".join(frags) + b']}')
+    return out
+
+
 class _Deployment:
     """One loaded (engine, instance, algorithms, models, serving) set;
     replaced wholesale by /reload."""
@@ -225,6 +345,10 @@ class _Deployment:
         self.obs = obs if obs is not None else _ServeInstruments()
         self.query_class = next(
             (a.query_class for a in algos if a.query_class is not None), None)
+        # wire fast path: a (user, num) constructor when the query class
+        # fits the compiled shape — derived once here, consulted per
+        # request with a single attribute read
+        self.fast_ctor = _derive_fast_ctor(self.query_class)
 
     def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
         """supplement -> per-algo batch_predict -> serve, for a batch;
@@ -344,6 +468,13 @@ class _MicroBatcher:
         self.queue_max = queue_max
         self.submit_timeout_s = submit_timeout_s
         self.obs = obs if obs is not None else _ServeInstruments()
+        # optional batch wire encoder: (deployment, results) -> one
+        # pre-serialized body per result (or None to decline the batch).
+        # Runs in the DRAINER, once per batch, so the per-request wire
+        # fast path never serializes anything itself.
+        self.encoder: Optional[
+            Callable[[Any, Sequence[Any]],
+                     Optional[List[Optional[bytes]]]]] = None
         self._lock = threading.Lock()
         # wakes the drainer the moment a full batch forms, so a batch
         # that fills mid-window ships immediately instead of sleeping
@@ -422,6 +553,17 @@ class _MicroBatcher:
                deadline: Optional[Deadline] = None,
                tenant: str = DEFAULT_TENANT, weight: float = 1.0,
                tenant_queue_max: int = 0) -> Any:
+        return self.submit_slot(deployment, query, deadline=deadline,
+                                tenant=tenant, weight=weight,
+                                tenant_queue_max=tenant_queue_max)["result"]
+
+    def submit_slot(self, deployment: _Deployment, query: Any,
+                    deadline: Optional[Deadline] = None,
+                    tenant: str = DEFAULT_TENANT, weight: float = 1.0,
+                    tenant_queue_max: int = 0) -> Dict[str, Any]:
+        """submit(), but returns the drained slot dict — "result" plus,
+        when the batch encoder ran, the pre-serialized "wire" body the
+        fast path writes straight to the socket."""
         done = threading.Event()
         slot: Dict[str, Any] = {}
         item = (deployment, query, done, slot, time.perf_counter(), tenant)
@@ -498,7 +640,7 @@ class _MicroBatcher:
                 f"{self.submit_timeout_s:.1f}s")
         if "error" in slot:
             raise slot["error"]
-        return slot["result"]
+        return slot
 
     def _drain_loop(self):
         batch: List[tuple] = []
@@ -591,8 +733,17 @@ class _MicroBatcher:
             queries = [item[1] for item in items]
             try:
                 results = dep.predict_batch(queries)
-                for (_, _, done, slot, _, _), r in zip(items, results):
+                wires: Optional[List[Optional[bytes]]] = None
+                if self.encoder is not None:
+                    try:
+                        wires = self.encoder(dep, results)
+                    except Exception:
+                        wires = None     # encoder bugs degrade, not fail
+                for i, ((_, _, done, slot, _, _), r) in enumerate(
+                        zip(items, results)):
                     slot["result"] = r
+                    if wires is not None and wires[i] is not None:
+                        slot["wire"] = wires[i]
                     done.set()
             except Exception as e:
                 for _, _, done, slot, _, _ in items:
@@ -638,6 +789,13 @@ class PredictionServer(HTTPServerBase):
                                        submit_timeout_s=(
                                            config.submit_timeout_ms / 1000.0))
                         if config.batch_window_ms > 0 else None)
+        if self._batcher is not None:
+            self._batcher.encoder = _encode_scores_batch
+        # wire fast path instrument children resolved ONCE — the hot
+        # route increments them without a labels() dict round-trip
+        self._fq_ok = self._req_counter.labels(
+            route="/queries.json", method="POST", status="200")
+        self._fq_hist = self._req_hist.labels(route="/queries.json")
         # latency bookkeeping (CreateServer.scala:399-401,584-591);
         # updated from concurrent handler threads, hence the lock.
         self._stats_lock = threading.Lock()
@@ -953,6 +1111,119 @@ class PredictionServer(HTTPServerBase):
             out.update(response_extra)
         return out
 
+    # -- wire fast path ------------------------------------------------------
+    def _fast_queries(self, raw: RawRequest) -> Optional[bytes]:
+        """/queries.json answered straight off the raw frame: compiled
+        query-shape match, header-lite auth, micro-batch submit, and a
+        response spliced from the batch encoder's pre-serialized body —
+        no header dict, no Request object, no per-request json.dumps or
+        json.loads. Returns None to delegate to the generic Router route
+        (which IS the json.loads fallback) whenever the request or the
+        server configuration falls outside the compiled shape: no
+        batcher, no fast constructor, feedback or plugins active, or a
+        body that is not exactly {"user": <str>, "num": <int>}."""
+        batcher = self._batcher
+        dep = self._dep
+        if batcher is None or dep is None or dep.fast_ctor is None \
+                or self.config.feedback \
+                or self.plugin_context.output_blockers \
+                or self.plugin_context.output_sniffers:
+            return None
+        m = _FAST_QUERY_RE.match(raw.body)
+        if m is None:
+            return None
+        try:
+            user = m.group(1).decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+        t0 = time.perf_counter()
+        rid = raw.header("X-Request-ID") or ""
+        keep = raw.keep_alive
+        tenant: Optional[TenantIdentity] = None
+        admitted = False
+        try:
+            try:
+                deadline = deadline_from_header(
+                    raw.header(DEADLINE_HEADER), self.default_deadline_ms)
+            except ValueError as e:
+                return self._fast_finish(400, str(e), rid, keep, t0)
+            if deadline is not None and deadline.expired:
+                return self._fast_finish(
+                    504, "deadline expired before processing", rid, keep, t0)
+            if self.admission.enabled:
+                tenant = self.admission.resolve_raw(
+                    _scan_access_key(raw.query_string),
+                    raw.header(TENANT_HEADER), raw.header("Authorization"))
+            with self._limiter:
+                admitted = True
+                with self.admission.admit(tenant):
+                    label, weight, tqmax = \
+                        self.admission.batch_params(tenant)
+                    slot = batcher.submit_slot(
+                        dep, dep.fast_ctor(user, int(m.group(2))),
+                        deadline=deadline, tenant=label, weight=weight,
+                        tenant_queue_max=tqmax)
+        except HTTPError as e:
+            return self._fast_finish(e.status, e.message, rid, keep, t0,
+                                     extra=e.headers or None)
+        except DeadlineExceeded as e:
+            return self._fast_finish(504, str(e), rid, keep, t0)
+        except CircuitOpenError as e:
+            return self._fast_finish(503, str(e), rid, keep, t0,
+                                     retry_after=e.retry_after)
+        except OverloadedError as e:
+            if not admitted:
+                # the HTTP-plane inflight shed, counted exactly where
+                # the generic middleware counts it
+                self._shed_counter.labels(
+                    surface=self._limiter.surface, app="").inc()
+            return self._fast_finish(e.status, e.message, rid, keep, t0,
+                                     retry_after=e.retry_after)
+        except ValueError as e:
+            return self._fast_finish(400, str(e), rid, keep, t0)
+        except Exception as e:
+            _log.exception(
+                "unhandled_error", request_id=rid, method="POST",
+                path="/queries.json", error=f"{type(e).__name__}: {e}")
+            return self._fast_finish(500, str(e), rid, keep, t0)
+        wire = slot.get("wire")
+        if wire is None:
+            # the batch encoder declined (exotic result type): one
+            # serialization here keeps the contract
+            wire = json.dumps(  # lint: ok (encoder-declined fallback)
+                to_jsonable(slot["result"])).encode("utf-8")
+        dt = time.perf_counter() - t0
+        if tenant is not None:
+            self._serve_obs.tenant_serve.labels(
+                app=tenant.label).observe(dt)
+        with self._stats_lock:
+            self.request_count += 1
+            self.last_serving_sec = dt
+            self.avg_serving_sec += (
+                (dt - self.avg_serving_sec) / self.request_count)
+        self._fq_ok.inc()
+        self._fq_hist.observe(dt)
+        return build_response(200, "application/json", wire, rid,
+                              keep_alive=keep)
+
+    def _fast_finish(self, status: int, message: str, rid: str,
+                     keep: bool, t0: float, extra=None,
+                     retry_after: Optional[float] = None) -> bytes:
+        """Terminal encode for a fast-path non-200: same metrics the
+        generic middleware would record, same JSON error envelope."""
+        dt = time.perf_counter() - t0
+        if retry_after is not None:
+            extra = dict(extra or ())
+            extra["Retry-After"] = str(max(1, round(retry_after)))
+        if status == 504:
+            self._deadline_counter.labels(route="/queries.json").inc()
+        self._req_counter.labels(route="/queries.json", method="POST",
+                                 status=str(status)).inc()
+        self._fq_hist.observe(dt)
+        body = b'{"message": ' + _json_str(message).encode("utf-8") + b'}'
+        return build_response(status, "application/json", body, rid,
+                              extra or None, keep_alive=keep)
+
     def _post_feedback(self, dep: _Deployment, query, prediction,
                        pr_id: str) -> None:
         """Async POST of the predict event back to the event server via a
@@ -1098,6 +1369,10 @@ class PredictionServer(HTTPServerBase):
 
         r.get("/plugins/<pname>")(plugin_rest)
         r.get("/plugins/<pname>/<args:path>")(plugin_rest)
+        # selector wire only: the raw-bytes hot route; everything it
+        # declines (return None) drops into the generic POST handler
+        # registered above
+        self.fast_route("POST", "/queries.json", self._fast_queries)
 
 
 def _gen_pr_id() -> str:

@@ -54,7 +54,7 @@ from predictionio_tpu.data.storage.base import TenantQuota
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
 from predictionio_tpu.resilience import OverloadedError
 from predictionio_tpu.utils.http import HTTPError, Request, \
-    parse_basic_auth_user
+    parse_basic_auth_value
 
 TENANT_HEADER = "X-PIO-App"
 # the label every request gets when tenancy is off (or a trusted-header
@@ -282,18 +282,30 @@ class AdmissionController:
         Raises HTTPError(401) on missing/invalid credentials."""
         if not self.config.enabled:
             return None
-        if self.config.trust_header:
-            hv = req.header(TENANT_HEADER)
-            if hv:
-                ident = self._parse_header(hv)
-                if ident is not None:
-                    return ident
+        return self.resolve_raw(
+            req.query_get("accessKey"), req.header(TENANT_HEADER),
+            req.header("Authorization"))
+
+    def resolve_raw(self, access_key: Optional[str],
+                    tenant_header: Optional[str],
+                    authorization: Optional[str]
+                    ) -> Optional[TenantIdentity]:
+        """Header-lite authentication for the wire fast path: the same
+        decision tree as `resolve()` but fed the three raw values the
+        selector wire scans out of the header block, so the hot route
+        never materializes a Request or a dict of headers."""
+        if not self.config.enabled:
+            return None
+        if self.config.trust_header and tenant_header:
+            ident = self._parse_header(tenant_header)
+            if ident is not None:
+                return ident
             # an unsigned/forged header, or direct traffic to a
             # trusted-header replica (tests, ops probes), falls
             # through to normal key auth
-        key = req.query_get("accessKey")
+        key = access_key
         if key is None:
-            key = parse_basic_auth_user(req.headers)
+            key = parse_basic_auth_value(authorization)
             if key is None:
                 raise HTTPError(401, "Missing accessKey.")
         now = time.monotonic()

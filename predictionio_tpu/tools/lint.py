@@ -42,6 +42,15 @@ Checks, per source file:
     per-tick accumulation into process-lifetime state is an unbounded
     memory leak; keep per-tick state tick-local, or mark a genuinely
     bounded accumulator ``# lint: ok``
+  - the serve wire hot route (serving/server.py fast-path functions,
+    utils/wire.py framing/service loop) must not call ``json.dumps``/
+    ``json.loads`` or build dict literals per request — the 10k-qps
+    wire path exists precisely because per-request dict assembly and
+    generic JSON (de)serialization dominated the old stack; responses
+    are spliced from pre-encoded fragments and headers are scanned in
+    place. ``dict(...)`` constructor calls pass (rare, explicit);
+    ``# lint: ok`` on the line is the escape hatch for documented
+    fallbacks (e.g. the encoder-declined single serialization)
   - tenancy layers (tenancy/, serving/) must not grow tenant-keyed
     containers unboundedly — ``x[...] = ...`` / ``.setdefault(`` on a
     name containing ``tenant``/``lane`` is per-REMOTE-PRINCIPAL state:
@@ -99,6 +108,13 @@ _STREAMING_DIRS = ("predictionio_tpu/streaming/",)
 # multi-tenant admission layers: tenant-keyed state is per-REMOTE-
 # PRINCIPAL memory, which an access-key-cycling client grows at will
 _TENANCY_DIRS = ("predictionio_tpu/tenancy/", "predictionio_tpu/serving/")
+
+# the serve wire hot route: files and function names on the
+# per-request path where generic JSON and dict assembly are banned
+_HOT_ROUTE_FILES = ("predictionio_tpu/serving/server.py",
+                    "predictionio_tpu/utils/wire.py")
+_HOT_ROUTE_FUNCS = ("frame_request", "build_response", "header",
+                    "_service", "_pump")
 
 # container-name fragments the tenant-growth rule keys on
 _TENANT_NAME_FRAGMENTS = ("tenant", "lane")
@@ -450,6 +466,54 @@ def _check_streaming_accumulation(tree: ast.AST, text: str,
                "tick-local, or mark a bounded accumulator '# lint: ok'")
 
 
+def _check_hot_route(tree: ast.AST, text: str, rel: str) -> Iterator[str]:
+    """On the serve wire hot route (serving/server.py ``_fast_*``
+    functions and the wire.py framing/service loop): forbid per-request
+    ``json.dumps(``/``json.loads(`` and dict-literal/comprehension
+    construction. The selector wire's whole throughput win is that the
+    per-request path touches no generic JSON codec and allocates no
+    header/result dicts — a regression here silently re-serializes the
+    route the bench gates. Explicit ``dict(...)`` constructor calls
+    pass (rare, visible); ``# lint: ok`` on the line is the escape
+    hatch for documented fallbacks."""
+    if rel not in _HOT_ROUTE_FILES:
+        return
+    lines = text.splitlines()
+
+    def escaped(lineno: int) -> bool:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        return "# lint: ok" in line
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (node.name.startswith("_fast")
+                or node.name in _HOT_ROUTE_FUNCS):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Dict, ast.DictComp)):
+                if escaped(sub.lineno):
+                    continue
+                kind = ("dict literal" if isinstance(sub, ast.Dict)
+                        else "dict comprehension")
+                yield (f"{rel}:{sub.lineno}: {kind} in hot-route "
+                       f"'{node.name}' allocates per request; splice "
+                       "pre-encoded fragments or scan in place (or "
+                       "mark '# lint: ok')")
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("dumps", "loads") \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == "json":
+                if escaped(sub.lineno):
+                    continue
+                yield (f"{rel}:{sub.lineno}: json.{sub.func.attr}() in "
+                       f"hot-route '{node.name}' re-serializes the "
+                       "wire path; use the compiled shape match / "
+                       "pre-encoded fragments (or mark '# lint: ok' "
+                       "for a documented fallback)")
+
+
 def _tenant_named(node: ast.AST) -> str:
     """The tenant-suggesting name behind an expression, or ''."""
     name = ""
@@ -532,6 +596,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_device_transfers(tree, text, rel))
     out.extend(_check_training_reads(tree, text, rel))
     out.extend(_check_streaming_accumulation(tree, text, rel))
+    out.extend(_check_hot_route(tree, text, rel))
     out.extend(_check_tenant_growth(tree, text, rel))
     return out
 

@@ -1,11 +1,22 @@
-"""Minimal threaded HTTP framework used by every host-side server.
+"""Minimal HTTP framework used by every host-side server.
 
 The reference builds its REST planes on spray/akka actors
 (`data/.../api/EventServer.scala`, `core/.../workflow/CreateServer.scala`,
 `tools/.../dashboard/Dashboard.scala`). Here one stdlib-based router serves
-all of them: the servers are host-side control planes — the hot compute
-path lives on device — so a threaded stdlib server is sufficient and keeps
-the framework dependency-free.
+all of them, over one of two interchangeable wires:
+
+  - `selector` (default): the readiness-loop front end in
+    `utils/wire.py` — persistent keep-alive connections multiplexed by
+    one reactor thread over a small worker pool, incremental framing,
+    and a `fast_route` hook that lets a server answer a hot route
+    straight from the raw bytes (no header dict, no Request object) —
+    the serve-plane wire overhaul behind the 10k-qps path;
+  - `threaded`: the original `ThreadingHTTPServer` thread-per-connection
+    stack, kept as the `PIO_SERVE_WIRE=threaded` escape hatch and used
+    automatically when TLS is configured (the selector loop does not
+    speak TLS).
+
+Routing, middleware, and handler contracts are identical on both wires.
 
 Features: method+path-pattern routing with `<name>` captures, JSON
 request/response helpers, query params, per-request context, graceful
@@ -31,6 +42,7 @@ subclass `readiness()` hook — model loaded, breakers closed).
 from __future__ import annotations
 
 import json
+import os
 import re
 import ssl as ssl_module
 import threading
@@ -46,6 +58,9 @@ from predictionio_tpu.obs import (
 from predictionio_tpu.resilience import (
     DEADLINE_HEADER, Deadline, DeadlineExceeded, CircuitOpenError,
     InflightLimiter, OverloadedError, deadline_from_header, deadline_scope,
+)
+from predictionio_tpu.utils.wire import (
+    RawRequest, SelectorWire, build_response,
 )
 
 _log = get_logger("http")
@@ -218,7 +233,8 @@ class HTTPServerBase:
         self.port = port
         self.router = Router()
         self._ssl_context = ssl_context
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        # ThreadingHTTPServer or SelectorWire — same lifecycle surface
+        self._httpd: Optional[Any] = None
         self._thread: Optional[threading.Thread] = None
         self._lifecycle_lock = threading.Lock()
         # one process-default registry unless a test passes its own, so a
@@ -246,6 +262,22 @@ class HTTPServerBase:
         self.router.get("/metrics")(self._metrics_endpoint)
         self.router.get("/health")(self._health_endpoint)
         self.router.get("/ready")(self._ready_endpoint)
+        # hot-route hook (selector wire only): (method, path) -> a
+        # handler taking the RAW framed request and returning complete
+        # response bytes, or None to fall through to the Router path.
+        # Only /queries.json rides this; every legacy route keeps the
+        # full Request/middleware pipeline.
+        self._fast_routes: Dict[Tuple[str, str],
+                                Callable[[RawRequest], Optional[bytes]]] = {}
+        self.wire = "unstarted"
+
+    def fast_route(self, method: str, path: str,
+                   fn: Callable[[RawRequest], Optional[bytes]]) -> None:
+        """Register a raw-bytes handler for one exact (method, path).
+        The handler returns a full HTTP response as bytes, or None to
+        delegate to the normal Router dispatch (the fallback path MUST
+        exist as a registered route)."""
+        self._fast_routes[(method.upper(), path)] = fn
 
     def _metrics_endpoint(self, req: Request) -> Response:
         return Response.text(
@@ -291,6 +323,40 @@ class HTTPServerBase:
             return Response.json(
                 {"message": e.message}, e.status,
                 **{"Retry-After": str(max(1, round(e.retry_after)))})
+
+    # -- selector-wire raw path ---------------------------------------------
+    def _handle_raw(self, raw: RawRequest) -> Tuple[bytes, bool]:
+        """The selector wire's single entry point: try the fast-route
+        table on the raw frame, else materialize a full Request and run
+        the identical middleware + Router pipeline the threaded wire
+        uses. Returns (response bytes, close connection?)."""
+        fast = self._fast_routes.get((raw.method, raw.path))
+        if fast is not None:
+            out = fast(raw)
+            if out is not None:
+                return out, not raw.keep_alive
+        rid = raw.header("X-Request-ID") or new_request_id()
+        raw_q = parse_qs(raw.query_string, keep_blank_values=True)
+        req = Request(
+            method=raw.method, path=raw.path,
+            query={k: v[0] for k, v in raw_q.items()},
+            headers=dict(raw.header_items()), body=raw.body,
+            client=raw.client, request_id=rid)
+        started = time.perf_counter()
+        resp = self._handle(req)
+        self._observe_request(req, resp, time.perf_counter() - started)
+        payload = resp.body
+        if isinstance(payload, bytes):
+            data = payload
+        elif isinstance(payload, str):
+            data = payload.encode("utf-8")
+        else:
+            data = json.dumps(payload).encode("utf-8")
+        out = build_response(
+            resp.status, resp.content_type, data, rid,
+            dict(resp.headers) if resp.headers else None,
+            keep_alive=raw.keep_alive, head_only=raw.method == "HEAD")
+        return out, not raw.keep_alive
 
     def _observe_request(self, req: Request, resp: Response,
                          duration: float) -> None:
@@ -367,16 +433,29 @@ class HTTPServerBase:
                 server_ref.log_request_line(fmt % args)
 
         # Deep listen backlog: the stdlib default of 5 drops connections
-        # (ECONNRESET) under concurrent client bursts. Daemon
-        # thread-per-connection (ThreadingHTTPServer's default) stays:
-        # a worker-pool variant measured marginally faster but lets 33+
-        # idle keep-alive connections starve every worker, and
-        # ThreadPoolExecutor's non-daemon threads hang process exit on
-        # one silent client. The handler timeout bounds how long an
+        # (ECONNRESET) under concurrent client bursts. On the threaded
+        # wire, daemon thread-per-connection stays (an earlier
+        # worker-pool variant let idle keep-alive connections starve
+        # every worker — the selector wire solves that with readiness
+        # multiplexing instead); the handler timeout bounds how long an
         # idle keep-alive connection can pin its (daemon) thread.
         _Server = type("_Server", (ThreadingHTTPServer,),
                        {"request_queue_size": 128})
         _Handler.timeout = 60
+        # wire selection: the selector readiness loop is the default;
+        # PIO_SERVE_WIRE=threaded is the escape hatch, and TLS always
+        # takes the threaded wire (the selector loop does not speak
+        # ssl's WantRead/WantWrite dance)
+        want = os.environ.get("PIO_SERVE_WIRE", "selector").lower()
+        use_selector = want != "threaded" and self._ssl_context is None
+        self.wire = "selector" if use_selector else "threaded"
+
+        def _bind():
+            if use_selector:
+                return SelectorWire((self.host, self.port),
+                                    self._handle_raw)
+            return _Server((self.host, self.port), _Handler)
+
         # 3-attempt bind with backoff (the reference retries Http.Bind
         # three times before giving up, CreateServer.scala:260-285) —
         # covers the port-release lag after stopping a previous server.
@@ -385,7 +464,7 @@ class HTTPServerBase:
         import errno
         for attempt in range(3):
             try:
-                self._httpd = _Server((self.host, self.port), _Handler)
+                self._httpd = _bind()
                 break
             except OSError as e:
                 if attempt == 2 or e.errno != errno.EADDRINUSE:
@@ -422,11 +501,10 @@ class HTTPServerBase:
         pass
 
 
-def parse_basic_auth_user(headers: Mapping[str, str]) -> Optional[str]:
-    """Extract the username of a Basic Authorization header (the reference
-    accepts the access key as the Basic username, EventServer.scala:114-126)."""
+def parse_basic_auth_value(auth: Optional[str]) -> Optional[str]:
+    """Username out of one raw `Authorization` header value — the
+    header-lite form the wire fast path feeds straight from its scan."""
     import base64
-    auth = headers.get("Authorization") or headers.get("authorization")
     if not auth or not auth.startswith("Basic "):
         return None
     try:
@@ -434,3 +512,10 @@ def parse_basic_auth_user(headers: Mapping[str, str]) -> Optional[str]:
     except Exception:
         return None
     return decoded.split(":")[0].strip() or None
+
+
+def parse_basic_auth_user(headers: Mapping[str, str]) -> Optional[str]:
+    """Extract the username of a Basic Authorization header (the reference
+    accepts the access key as the Basic username, EventServer.scala:114-126)."""
+    return parse_basic_auth_value(
+        headers.get("Authorization") or headers.get("authorization"))

@@ -1,0 +1,596 @@
+"""Selector readiness-loop HTTP/1.1 front end — the 10k-qps wire path.
+
+Three bench rounds (BENCH_r03-r05) showed the device finishing a serve
+batch in ~2 ms while microbatched throughput plateaued near 500-900 qps:
+the ceiling was thread-per-connection handoffs and per-request header
+dict construction in the stdlib `ThreadingHTTPServer` stack, not the
+accelerator. This module replaces that stack for the serve plane:
+
+  - ONE reactor thread multiplexes every persistent keep-alive
+    connection through a `selectors` readiness loop (accept + recv +
+    incremental framing only — never a handler);
+  - a small fixed worker pool runs handlers, so 10k idle keep-alive
+    connections cost one selector registration each instead of one
+    blocked thread each (the documented starvation failure of the
+    earlier worker-pool experiment in utils/http.py);
+  - framing is incremental and allocation-lean: the header block is
+    carried as one bytes slice and scanned in place for the few headers
+    a route needs (`RawRequest.header`), with NO dict-of-headers built
+    until a legacy route asks for one; the body is sliced out of the
+    recv buffer exactly once;
+  - responses are assembled as a single bytes join from pre-encoded
+    status lines and written with one send loop.
+
+The wire knows nothing about routes, JSON, metrics, or tenancy: it
+calls one `handler(RawRequest) -> (response_bytes, close?)` supplied by
+`utils/http.HTTPServerBase`, which layers routing + middleware on top
+and picks this wire or the legacy threaded one via `PIO_SERVE_WIRE`.
+
+Also here: `HTTPConnectionPool`, the persistent-upstream client side of
+the same story — the fleet router proxies over reused
+`http.client.HTTPConnection`s instead of dialing per request.
+
+Deliberately stdlib-only and obs-free: the observability middleware
+lives one layer up, and malformed-framing rejects (400/413/431/501) are
+answered from a static table before any route exists.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import select
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+# Framing limits: a head that never completes under the cap is 431, a
+# declared body over the cap is 413 (both close the connection — the
+# stream position is unrecoverable).
+MAX_HEADER_BYTES = 16 << 10
+MAX_BODY_BYTES = int(os.environ.get("PIO_WIRE_MAX_BODY", str(8 << 20)))
+# idle keep-alive connections are swept after this long (mirrors the
+# threaded wire's 60 s handler timeout)
+KEEPALIVE_IDLE_S = float(os.environ.get("PIO_WIRE_IDLE_S", "65"))
+# framed-but-unserved requests a pipelining client may stack up before
+# the reactor stops parsing its buffer (bounds memory per connection)
+PIPELINE_MAX = 64
+_RECV_CHUNK = 1 << 18
+_SEND_TIMEOUT_S = 30.0
+
+RawHandler = Callable[["RawRequest"], Tuple[bytes, bool]]
+
+_REASONS = http.client.responses
+_STATUS_LINES: Dict[int, bytes] = {
+    code: (f"HTTP/1.1 {code} {reason}\r\n".encode("ascii"))
+    for code, reason in _REASONS.items()
+}
+
+
+def _status_line(code: int) -> bytes:
+    line = _STATUS_LINES.get(code)
+    if line is None:
+        line = b"HTTP/1.1 %d Status\r\n" % code
+    return line
+
+
+class RawRequest:
+    """One framed request: request-line fields plus the UNPARSED header
+    block. Hot routes scan `header()` for the few names they need; the
+    legacy path materializes a dict via `header_items()`."""
+
+    __slots__ = ("method", "target", "path", "query_string", "head",
+                 "body", "keep_alive", "client", "_lhead")
+
+    def __init__(self, method: str, target: str, head: bytes,
+                 client: str = ""):
+        self.method = method
+        self.target = target
+        path, _, qs = target.partition("?")
+        self.path = path
+        self.query_string = qs
+        self.head = head          # header block, no request line, no CRLFCRLF
+        self.body = b""
+        self.keep_alive = True
+        self.client = client
+        self._lhead: Optional[bytes] = None
+
+    def header(self, name: str) -> Optional[str]:
+        """Case-insensitive single-header scan over the raw block — no
+        dict, one lazy lowercase copy per request shared by every
+        lookup."""
+        lh = self._lhead
+        if lh is None:
+            lh = self._lhead = b"\r\n" + self.head.lower()
+        key = b"\r\n" + name.lower().encode("ascii") + b":"
+        i = lh.find(key)
+        if i < 0:
+            return None
+        start = i + len(key)
+        end = lh.find(b"\r\n", start)
+        if end < 0:
+            end = len(lh)
+        return self.head[start - 2:end - 2].decode("latin-1").strip()
+
+    def header_items(self) -> List[Tuple[str, str]]:
+        """All headers as (name, value) pairs — the legacy-route path
+        that builds a Request with a dict of headers."""
+        out = []
+        for line in self.head.split(b"\r\n"):
+            name, sep, value = line.partition(b":")
+            if sep:
+                out.append((name.decode("latin-1").strip(),
+                            value.decode("latin-1").strip()))
+        return out
+
+
+class WireError(Exception):
+    """Malformed framing; answered from a static table and the
+    connection closes (the stream position is unrecoverable)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def build_response(status: int, content_type: str, body: bytes,
+                   rid: str = "", extra: Optional[Dict[str, str]] = None,
+                   keep_alive: bool = True,
+                   head_only: bool = False) -> bytes:
+    """Assemble one HTTP/1.1 response as a single bytes object."""
+    parts = [_status_line(status),
+             b"Content-Type: ", content_type.encode("latin-1"), b"\r\n",
+             b"Content-Length: %d\r\n" % len(body)]
+    if rid:
+        parts.append(b"X-Request-ID: " + rid.encode("latin-1") + b"\r\n")
+    if extra:
+        for k, v in extra.items():
+            parts.append(f"{k}: {v}\r\n".encode("latin-1"))
+    if not keep_alive:
+        parts.append(b"Connection: close\r\n")
+    parts.append(b"\r\n")
+    if not head_only:
+        parts.append(body)
+    return b"".join(parts)
+
+
+def _error_bytes(e: WireError) -> bytes:
+    # static messages only — no user input is ever echoed into this
+    # JSON, so the manual quoting cannot be broken by it
+    body = b'{"message": "%s"}' % e.message.encode("ascii", "replace")
+    return build_response(e.status, "application/json", body,
+                          keep_alive=False)
+
+
+def frame_request(buf: bytearray, client: str = ""
+                  ) -> Tuple[Optional[RawRequest], int]:
+    """Try to frame one request at the head of `buf`.
+
+    Returns (request, bytes_consumed) when a full request (head + body)
+    is present, (None, 0) when more bytes are needed. Raises WireError
+    on malformed input. Pure function of the buffer — the caller owns
+    deleting the consumed prefix."""
+    he = buf.find(b"\r\n\r\n")
+    if he < 0:
+        if len(buf) > MAX_HEADER_BYTES:
+            raise WireError(431, "Request header block too large")
+        return None, 0
+    if he > MAX_HEADER_BYTES:
+        raise WireError(431, "Request header block too large")
+    head = bytes(buf[:he])
+    eol = head.find(b"\r\n")
+    line = head if eol < 0 else head[:eol]
+    fields = line.split(b" ")
+    if len(fields) != 3:
+        raise WireError(400, "Malformed request line")
+    method_b, target_b, version_b = fields
+    if not version_b.startswith(b"HTTP/1."):
+        raise WireError(400, "Unsupported HTTP version")
+    raw = RawRequest(method_b.decode("latin-1"),
+                     target_b.decode("latin-1"),
+                     b"" if eol < 0 else head[eol + 2:], client)
+    if raw.header("Transfer-Encoding") is not None:
+        raise WireError(501, "Transfer-Encoding is not supported")
+    length = 0
+    cl = raw.header("Content-Length")
+    if cl is not None:
+        try:
+            length = int(cl)
+        except ValueError:
+            raise WireError(400, "Invalid Content-Length header")
+        if length < 0:
+            raise WireError(400, "Invalid Content-Length header")
+        if length > MAX_BODY_BYTES:
+            raise WireError(413, "Request body over size limit")
+    total = he + 4 + length
+    if len(buf) < total:
+        return None, 0
+    if length:
+        raw.body = bytes(memoryview(buf)[he + 4:total])
+    conn_tok = raw.header("Connection")
+    if version_b == b"HTTP/1.0":
+        raw.keep_alive = (conn_tok is not None
+                          and conn_tok.lower() == "keep-alive")
+    else:
+        raw.keep_alive = (conn_tok is None
+                          or conn_tok.lower() != "close")
+    return raw, total
+
+
+class _Conn:
+    __slots__ = ("sock", "fd", "client", "buf", "pending", "busy",
+                 "closing", "last_active", "lock")
+
+    def __init__(self, sock: socket.socket, client: str):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.client = client
+        self.buf = bytearray()
+        # entries: ("req", RawRequest) | ("err", response_bytes)
+        self.pending: Deque[tuple] = deque()
+        self.busy = False          # a worker currently owns this conn
+        self.closing = False
+        self.last_active = time.monotonic()
+        self.lock = threading.Lock()
+
+
+class SelectorWire:
+    """The selector front end. API mirrors ThreadingHTTPServer just
+    enough (`server_address`, `serve_forever`, `shutdown`,
+    `server_close`) that HTTPServerBase treats both wires uniformly."""
+
+    def __init__(self, server_address: Tuple[str, int],
+                 handler: RawHandler, workers: int = 0):
+        self._handler = handler
+        self._stop = False
+        self._done = threading.Event()
+        self._lifecycle = threading.Lock()
+        self._conns: Dict[int, _Conn] = {}
+        self._to_close: Deque[_Conn] = deque()
+        if workers <= 0:
+            # Workers BLOCK in the handler (device step, store reads),
+            # they are not CPU-bound — size the pool to cover the
+            # admission layer's concurrency, not the core count, or
+            # overload queues invisibly at the wire instead of shedding
+            # 429/503 with Retry-After at the app layer.
+            workers = int(os.environ.get(
+                "PIO_WIRE_WORKERS",
+                str(max(16, min(64, 4 * (os.cpu_count() or 4))))))
+        self._n_workers = max(1, workers)
+        import queue as _queue
+        self._workq: "_queue.Queue" = _queue.Queue()
+        self._workers: List[threading.Thread] = []
+        # bind in the constructor so the caller's EADDRINUSE retry loop
+        # wraps construction, exactly as with ThreadingHTTPServer
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            ls.bind(server_address)
+        except OSError:
+            ls.close()
+            raise
+        ls.listen(1024)
+        ls.setblocking(False)
+        self._listener = ls
+        self.server_address = ls.getsockname()
+        # wake pipe: shutdown() and worker close-requests nudge select()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+
+    # -- reactor -------------------------------------------------------------
+    def serve_forever(self) -> None:
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"wire-worker-{i}")
+            t.start()
+            self._workers.append(t)
+        sel = self._sel
+        sel.register(self._listener, selectors.EVENT_READ, "accept")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        last_sweep = time.monotonic()
+        try:
+            while not self._stop:
+                for key, _ in sel.select(1.0):
+                    if key.data == "accept":
+                        self._accept()
+                    elif key.data == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    else:
+                        self._on_readable(key.data)
+                self._drain_close_requests()
+                now = time.monotonic()
+                if now - last_sweep >= 5.0:
+                    last_sweep = now
+                    self._sweep_idle(now)
+        finally:
+            self._done.set()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, addr = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock, addr[0] if addr else "")
+            self._conns[conn.fd] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _on_readable(self, conn: _Conn) -> None:
+        eof = False
+        try:
+            while True:
+                data = conn.sock.recv(_RECV_CHUNK)
+                if not data:
+                    eof = True
+                    break
+                conn.buf.extend(data)
+                if len(data) < _RECV_CHUNK:
+                    break
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            eof = True
+        conn.last_active = time.monotonic()
+        if conn.buf:
+            self._pump(conn)
+        if eof:
+            with conn.lock:
+                busy_or_pending = conn.busy or bool(conn.pending)
+                conn.closing = True
+            self._unregister(conn)
+            if not busy_or_pending:
+                self._destroy(conn)
+
+    def _pump(self, conn: _Conn) -> None:
+        """Frame every complete request in the buffer (up to the
+        pipeline cap) and hand the connection to a worker."""
+        added = False
+        while len(conn.pending) < PIPELINE_MAX:
+            try:
+                raw, consumed = frame_request(conn.buf, conn.client)
+            except WireError as e:
+                with conn.lock:
+                    conn.pending.append(("err", _error_bytes(e)))
+                    conn.closing = True
+                self._unregister(conn)
+                added = True
+                break
+            if raw is None:
+                break
+            del conn.buf[:consumed]
+            with conn.lock:
+                conn.pending.append(("req", raw))
+            added = True
+        if added:
+            with conn.lock:
+                if not conn.busy and conn.pending:
+                    conn.busy = True
+                    self._workq.put(conn)
+
+    def _sweep_idle(self, now: float) -> None:
+        for conn in list(self._conns.values()):
+            with conn.lock:
+                idle = (not conn.busy and not conn.pending
+                        and not conn.buf
+                        and now - conn.last_active > KEEPALIVE_IDLE_S)
+            if idle:
+                self._unregister(conn)
+                self._destroy(conn)
+
+    def _drain_close_requests(self) -> None:
+        while self._to_close:
+            conn = self._to_close.popleft()
+            self._unregister(conn)
+            self._destroy(conn)
+
+    def _unregister(self, conn: _Conn) -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _destroy(self, conn: _Conn) -> None:
+        self._conns.pop(conn.fd, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    # -- workers -------------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            conn = self._workq.get()
+            if conn is None:
+                return
+            self._service(conn)
+
+    def _service(self, conn: _Conn) -> None:
+        """Serve this connection's framed requests in order; the busy
+        flag guarantees one worker per connection, so pipelined
+        responses cannot interleave."""
+        while True:
+            with conn.lock:
+                if not conn.pending:
+                    conn.busy = False
+                    close_now = conn.closing
+                    break
+                kind, item = conn.pending.popleft()
+            if kind == "err":
+                self._send(conn, item)
+                self._request_close(conn)
+                return
+            try:
+                data, close = self._handler(item)
+            except Exception:
+                data, close = build_response(
+                    500, "application/json",
+                    b'{"message": "internal wire error"}',
+                    keep_alive=False), True
+            if not self._send(conn, data) or close or not item.keep_alive:
+                self._request_close(conn)
+                return
+            conn.last_active = time.monotonic()
+        if close_now:
+            self._request_close(conn)
+
+    def _send(self, conn: _Conn, data: bytes) -> bool:
+        """Blocking-with-timeout send on the nonblocking socket; small
+        responses nearly always complete in one call."""
+        mv = memoryview(data)
+        end = time.monotonic() + _SEND_TIMEOUT_S
+        sock = conn.sock
+        while mv:
+            try:
+                n = sock.send(mv)
+                mv = mv[n:]
+            except (BlockingIOError, InterruptedError):
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                try:
+                    select.select([], [sock], [], min(remaining, 1.0))
+                except (OSError, ValueError):
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def _request_close(self, conn: _Conn) -> None:
+        """Workers never touch the selector: shut the socket down and
+        let the reactor unregister + close it."""
+        with conn.lock:
+            conn.closing = True
+        try:
+            conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._to_close.append(conn)
+        self._wake()
+
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake()
+        self._done.wait(timeout=5.0)
+
+    def server_close(self) -> None:
+        with self._lifecycle:
+            workers, self._workers = self._workers, []
+        for _ in workers:
+            self._workq.put(None)
+        for t in workers:
+            t.join(timeout=2.0)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._conns.values()):
+            self._unregister(conn)
+            self._destroy(conn)
+        try:
+            self._sel.close()
+        except Exception:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class HTTPConnectionPool:
+    """Persistent upstream connections for the fleet proxy.
+
+    The router used to dial a fresh TCP connection per proxied request
+    (urllib): at wire-path throughput the handshake dominates. This
+    pool checks out a kept-alive `http.client.HTTPConnection` per
+    (host, port), retries exactly once on a stale reuse (the upstream
+    closed its keep-alive between our requests), and returns transport
+    failures as OSError so the caller's retry-next-replica loop and
+    ejection bookkeeping stay unchanged."""
+
+    def __init__(self, max_idle_per_host: int = 4):
+        self.max_idle = max_idle_per_host
+        self._lock = threading.Lock()
+        self._idle: Dict[Tuple[str, int], Deque] = {}
+
+    def _checkout(self, host: str, port: int):
+        with self._lock:
+            q = self._idle.get((host, port))
+            if q:
+                return q.popleft(), True
+        return None, False
+
+    def _checkin(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            q = self._idle.setdefault((host, port), deque())
+            if len(q) < self.max_idle:
+                q.append(conn)
+                return
+        conn.close()
+
+    def request(self, host: str, port: int, method: str, path: str,
+                body: Optional[bytes], headers: Dict[str, str],
+                timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+        """One proxied request over a pooled connection. Returns
+        (status, response headers, body). Transport-level failures
+        raise OSError after at most one stale-connection retry."""
+        attempts = 0
+        while True:
+            conn, reused = self._checkout(host, port)
+            if conn is None:
+                conn = http.client.HTTPConnection(host, port,
+                                                  timeout=timeout)
+            elif conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                # a reused connection the upstream already closed is
+                # expected with keep-alive; retry ONCE on a fresh dial
+                if reused and attempts == 0:
+                    attempts += 1
+                    continue
+                if isinstance(e, OSError):
+                    raise
+                raise OSError(f"{type(e).__name__}: {e}") from e
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(host, port, conn)
+            return resp.status, dict(resp.headers.items()), data
+
+    def close(self) -> None:
+        with self._lock:
+            pools, self._idle = self._idle, {}
+        for q in pools.values():
+            for conn in q:
+                try:
+                    conn.close()
+                except Exception:
+                    pass

@@ -338,6 +338,52 @@ def test_streaming_accumulation_gate_scoped_to_streaming(tmp_path):
     assert not lint.run(tmp_path)
 
 
+def test_hot_route_gate_catches_json_and_dicts(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import json\n"
+        "def _fast_queries(raw):\n"
+        "    obj = json.loads(raw.body)\n"
+        "    headers = {k: v for k, v in raw.header_items()}\n"
+        "    return json.dumps({'itemScores': obj}).encode()\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "json.loads() in hot-route '_fast_queries'" in kinds
+    assert "json.dumps() in hot-route '_fast_queries'" in kinds
+    assert "dict comprehension in hot-route" in kinds
+    assert "dict literal in hot-route" in kinds
+
+
+def test_hot_route_gate_allows_escape_and_cold_functions(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "utils" / "wire.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import json\n"
+        "def _service(conn):\n"
+        "    d = dict(a=1)\n"              # constructor call: explicit
+        "    return json.dumps(d)  # lint: ok (fallback)\n"
+        "def legacy_route(body):\n"        # not a hot-route function
+        "    return json.loads(body), {'x': 1}\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_hot_route_gate_scoped_to_wire_files(tmp_path):
+    # the same names elsewhere are not the wire hot path
+    ok = tmp_path / "predictionio_tpu" / "serving" / "other.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import json\n"
+        "def _fast_thing(body):\n"
+        "    return json.loads(body)\n"
+    )
+    assert not lint.run(tmp_path)
+
+
 def test_tenant_growth_gate_catches_unbounded_maps(tmp_path):
     bad = tmp_path / "predictionio_tpu" / "tenancy" / "leaky.py"
     bad.parent.mkdir(parents=True)

@@ -1,0 +1,424 @@
+"""Wire-path tests for the selector front end (`utils/wire.py`).
+
+Two layers, mirroring the module split:
+
+  - framing as a pure function: `frame_request` over hand-built byte
+    buffers — partial delivery, pipelining, every malformed-input
+    status (400/413/431/501), and the HTTP/1.0 vs 1.1 keep-alive
+    defaults;
+  - the live reactor: raw sockets against a `SelectorWire` running a
+    trivial echo handler — keep-alive reuse, pipelined response
+    ordering, trickled byte-at-a-time delivery, error-close behavior,
+    and graceful drain of an in-flight handler across `shutdown()`.
+
+Plus the fast-path parity fuzz: `_FAST_QUERY_RE` (the compiled
+/queries.json shape in `serving/server.py`) must never accept a body
+`json.loads` rejects, and must read the same (user, num) out of every
+body both can parse.
+"""
+
+import json
+import random
+import socket
+import string
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.serving.server import _FAST_QUERY_RE
+from predictionio_tpu.utils.wire import (
+    MAX_BODY_BYTES, MAX_HEADER_BYTES, RawRequest, SelectorWire, WireError,
+    build_response, frame_request,
+)
+
+pytestmark = pytest.mark.wire
+
+
+def _req(path="/echo", body=b"", version="1.1", method="POST",
+         headers=()):
+    head = [f"{method} {path} HTTP/{version}".encode("ascii"),
+            b"Host: t"]
+    if body or method == "POST":
+        head.append(b"Content-Length: %d" % len(body))
+    head.extend(headers)
+    return b"\r\n".join(head) + b"\r\n\r\n" + body
+
+
+# -- framing as a pure function ----------------------------------------------
+
+class TestFraming:
+    def test_partial_head_needs_more(self):
+        buf = bytearray(b"POST /q HTTP/1.1\r\nHost: t\r\n")
+        assert frame_request(buf) == (None, 0)
+        buf.extend(b"Content-Length: 2\r\n\r\n")
+        # head complete but body short by 2
+        assert frame_request(buf) == (None, 0)
+        buf.extend(b"hi")
+        raw, consumed = frame_request(buf)
+        assert raw is not None and consumed == len(buf)
+        assert raw.method == "POST" and raw.path == "/q"
+        assert raw.body == b"hi"
+
+    def test_pipelined_requests_frame_in_order(self):
+        buf = bytearray(_req(body=b"one") + _req(body=b"three")
+                        + _req(body=b"two")[:-1])
+        bodies = []
+        for _ in range(2):
+            raw, consumed = frame_request(buf)
+            assert raw is not None
+            del buf[:consumed]
+            bodies.append(raw.body)
+        assert bodies == [b"one", b"three"]
+        # the third is short one body byte; completes after delivery
+        assert frame_request(buf) == (None, 0)
+        buf.extend(b"o")
+        raw, consumed = frame_request(buf)
+        assert raw.body == b"two" and consumed == len(buf)
+
+    def test_query_string_split(self):
+        buf = bytearray(_req(path="/queries.json?accessKey=K&x=1"))
+        raw, _ = frame_request(buf)
+        assert raw.path == "/queries.json"
+        assert raw.query_string == "accessKey=K&x=1"
+
+    @pytest.mark.parametrize("cl", [b"abc", b"-1", b"1e3", b"0x10", b""])
+    def test_malformed_content_length_400(self, cl):
+        buf = bytearray(b"POST / HTTP/1.1\r\nContent-Length: " + cl
+                        + b"\r\n\r\n")
+        with pytest.raises(WireError) as ei:
+            frame_request(buf)
+        assert ei.value.status == 400
+
+    def test_oversized_declared_body_413(self):
+        buf = bytearray(b"POST / HTTP/1.1\r\nContent-Length: %d\r\n\r\n"
+                        % (MAX_BODY_BYTES + 1))
+        with pytest.raises(WireError) as ei:
+            frame_request(buf)
+        assert ei.value.status == 413
+
+    def test_at_limit_body_is_not_413(self):
+        buf = bytearray(b"POST / HTTP/1.1\r\nContent-Length: %d\r\n\r\n"
+                        % MAX_BODY_BYTES)
+        # not an error — just waiting on the body bytes
+        assert frame_request(buf) == (None, 0)
+
+    def test_unterminated_header_block_431(self):
+        buf = bytearray(b"POST / HTTP/1.1\r\nX: "
+                        + b"a" * (MAX_HEADER_BYTES + 8))
+        with pytest.raises(WireError) as ei:
+            frame_request(buf)
+        assert ei.value.status == 431
+
+    @pytest.mark.parametrize("line", [
+        b"POST /\r\n",                  # two fields
+        b"POST / HTTP/1.1 extra\r\n",   # four fields
+        b"POST / SPDY/3\r\n",           # wrong protocol
+        b"POST / HTTP/2\r\n",           # unsupported major version
+    ])
+    def test_bad_request_line_400(self, line):
+        buf = bytearray(line + b"\r\n")
+        with pytest.raises(WireError) as ei:
+            frame_request(buf)
+        assert ei.value.status == 400
+
+    def test_transfer_encoding_rejected_501(self):
+        buf = bytearray(b"POST / HTTP/1.1\r\n"
+                        b"Transfer-Encoding: chunked\r\n\r\n")
+        with pytest.raises(WireError) as ei:
+            frame_request(buf)
+        assert ei.value.status == 501
+
+    def test_keep_alive_defaults(self):
+        r11, _ = frame_request(bytearray(_req()))
+        assert r11.keep_alive
+        r11c, _ = frame_request(bytearray(
+            _req(headers=(b"Connection: close",))))
+        assert not r11c.keep_alive
+        r10, _ = frame_request(bytearray(_req(version="1.0")))
+        assert not r10.keep_alive
+        r10k, _ = frame_request(bytearray(
+            _req(version="1.0", headers=(b"Connection: keep-alive",))))
+        assert r10k.keep_alive
+
+    def test_header_scan_case_insensitive(self):
+        raw, _ = frame_request(bytearray(_req(
+            headers=(b"X-Request-ID: rid-7", b"AUTHORIZATION: Bearer t"))))
+        assert raw.header("x-request-id") == "rid-7"
+        assert raw.header("X-Request-Id") == "rid-7"
+        assert raw.header("authorization") == "Bearer t"
+        assert raw.header("X-Missing") is None
+        assert ("Host", "t") in raw.header_items()
+
+    def test_build_response_round_trips(self):
+        data = build_response(200, "application/json", b'{"a": 1}',
+                              rid="r1", extra={"Retry-After": "2"},
+                              keep_alive=False)
+        head, _, body = data.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200 OK\r\n")
+        assert b"Content-Length: 8\r\n" in head
+        assert b"X-Request-ID: r1\r\n" in head
+        assert b"Retry-After: 2\r\n" in head
+        assert head.endswith(b"Connection: close")
+        assert body == b'{"a": 1}'
+
+
+# -- the live reactor --------------------------------------------------------
+
+def _echo(raw: RawRequest):
+    if raw.path == "/slow":
+        time.sleep(0.5)
+    body = b"%s %s %s" % (raw.method.encode("ascii"),
+                          raw.path.encode("ascii"), raw.body)
+    return (build_response(200, "text/plain", body,
+                           keep_alive=raw.keep_alive),
+            not raw.keep_alive)
+
+
+def test_default_worker_pool_covers_admission_concurrency(monkeypatch):
+    """Workers block in the handler, so the default pool must exceed
+    the serve-layer shed limits even on a 1-core host — a smaller pool
+    serializes bursts at the wire and the 429/503 admission paths
+    (queue_max, max_inflight) never engage."""
+    monkeypatch.delenv("PIO_WIRE_WORKERS", raising=False)
+    srv = SelectorWire(("127.0.0.1", 0), _echo)
+    try:
+        assert srv._n_workers >= 16
+    finally:
+        srv.server_close()
+
+
+@pytest.fixture()
+def wire():
+    srv = SelectorWire(("127.0.0.1", 0), _echo, workers=2)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+def _connect(srv) -> socket.socket:
+    s = socket.create_connection(srv.server_address, timeout=5)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _read_response(f):
+    status = int(f.readline().split(b" ")[1])
+    length, closing = 0, False
+    while True:
+        line = f.readline().rstrip(b"\r\n")
+        if not line:
+            break
+        name, _, value = line.partition(b":")
+        if name.lower() == b"content-length":
+            length = int(value)
+        if (name.lower() == b"connection"
+                and value.strip().lower() == b"close"):
+            closing = True
+    return status, f.read(length), closing
+
+
+class TestSelectorWire:
+    def test_keepalive_connection_reuse(self, wire):
+        with _connect(wire) as s, s.makefile("rb") as f:
+            for i in range(12):
+                s.sendall(_req(body=b"n=%d" % i))
+                status, body, closing = _read_response(f)
+                assert status == 200 and body == b"POST /echo n=%d" % i
+                assert not closing
+            # same TCP connection served all twelve
+
+    def test_connection_close_honored(self, wire):
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(_req(headers=(b"Connection: close",)))
+            status, _, closing = _read_response(f)
+            assert status == 200 and closing
+            assert f.read(1) == b""      # server closed after responding
+
+    def test_pipelined_responses_in_order(self, wire):
+        n = 8
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(b"".join(_req(body=b"p%d" % i) for i in range(n)))
+            for i in range(n):
+                status, body, _ = _read_response(f)
+                assert status == 200 and body == b"POST /echo p%d" % i
+
+    def test_trickled_bytes_frame_incrementally(self, wire):
+        data = _req(body=b"slow-drip")
+        with _connect(wire) as s, s.makefile("rb") as f:
+            for i in range(0, len(data), 7):
+                s.sendall(data[i:i + 7])
+                time.sleep(0.002)
+            status, body, _ = _read_response(f)
+            assert status == 200 and body == b"POST /echo slow-drip"
+
+    def test_malformed_content_length_400_closes(self, wire):
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(b"POST / HTTP/1.1\r\nContent-Length: zz\r\n\r\n")
+            status, body, _ = _read_response(f)
+            assert status == 400 and b"Content-Length" in body
+            assert f.read(1) == b""      # framing errors close the stream
+
+    def test_oversized_body_413_closes(self, wire):
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(b"POST / HTTP/1.1\r\nContent-Length: %d\r\n\r\n"
+                      % (MAX_BODY_BYTES + 1))
+            status, body, _ = _read_response(f)
+            assert status == 413 and b"size limit" in body
+            assert f.read(1) == b""
+
+    def test_valid_after_malformed_on_new_connection(self, wire):
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(b"BAD\r\n\r\n")
+            status, _, _ = _read_response(f)
+            assert status == 400
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(_req(body=b"ok"))
+            status, body, _ = _read_response(f)
+            assert status == 200 and body == b"POST /echo ok"
+
+    def test_graceful_drain_of_inflight_request(self, wire):
+        """shutdown() stops the reactor; a request already handed to a
+        worker still completes and its response is delivered."""
+        with _connect(wire) as s, s.makefile("rb") as f:
+            s.sendall(_req(path="/slow", body=b"drain"))
+            time.sleep(0.15)             # reactor has pumped it by now
+            wire.shutdown()
+            status, body, _ = _read_response(f)
+            assert status == 200 and body == b"POST /slow drain"
+
+    def test_concurrent_connections(self, wire):
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            with _connect(wire) as s, s.makefile("rb") as f:
+                s.sendall(_req(body=b"c%d" % i))
+                status, body, _ = _read_response(f)
+                with lock:
+                    results.append((i, status, body))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 16
+        for i, status, body in results:
+            assert status == 200 and body == b"POST /echo c%d" % i
+
+
+# -- fast-path vs json.loads parity ------------------------------------------
+
+def _parse_generic(body: bytes):
+    """The generic route's view of a /queries.json body: the (user, num)
+    pair iff it is valid JSON of exactly that shape, else None."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if (not isinstance(obj, dict) or set(obj) != {"user", "num"}
+            or not isinstance(obj["user"], str)
+            or not isinstance(obj["num"], int)
+            or isinstance(obj["num"], bool)):
+        return None
+    return obj["user"], obj["num"]
+
+
+def _parse_fast(body: bytes):
+    m = _FAST_QUERY_RE.match(body)
+    if m is None:
+        return None
+    try:
+        return m.group(1).decode("utf-8"), int(m.group(2))
+    except UnicodeDecodeError:
+        return None
+
+
+class TestFastPathParity:
+    def test_canonical_shapes_take_the_fast_path(self):
+        for body, want in [
+            (b'{"user": "u1", "num": 4}', ("u1", 4)),
+            (b'{"user":"u1","num":4}', ("u1", 4)),
+            (b' \t\r\n{ "user" : "a b" , "num" : -3 }\n', ("a b", -3)),
+            (b'{"user": "", "num": 0}', ("", 0)),
+            ('{"user": "ünïcødé", "num": 7}'.encode("utf-8"),
+             ("ünïcødé", 7)),
+            (b'{"user": "u", "num": 999999999}', ("u", 999999999)),
+        ]:
+            assert _parse_fast(body) == want, body
+            assert _parse_generic(body) == want, body
+
+    def test_off_shape_bodies_fall_through(self):
+        for body in [
+            b'{"num": 4, "user": "u1"}',          # field order
+            b'{"user": "u1", "num": 4, "x": 1}',  # extra field
+            b'{"user": "a\\"b", "num": 4}',       # escape in string
+            b'{"user": 5, "num": 4}',             # numeric user
+            b'{"user": "u1", "num": 4.0}',        # float num
+            b'{"user": "u1", "num": 1234567890}',  # >9 digits
+            b'{"user": "u1", "num": true}',
+            b'{"user": "u1"}',
+            b'[]',
+            b'',
+        ]:
+            assert _parse_fast(body) is None, body
+
+    def test_fast_never_accepts_what_generic_rejects(self):
+        # the leading-zero class specifically: 01 is not JSON
+        for body in [b'{"user": "u", "num": 01}',
+                     b'{"user": "u", "num": -012}',
+                     b'{"user": "u", "num": 00}']:
+            assert _parse_generic(body) is None
+            assert _parse_fast(body) is None, body
+
+    def test_fuzz_parity(self):
+        rng = random.Random(0xA11CE)
+        user_chars = (string.ascii_letters + string.digits
+                      + " .:/@#$%&*()[]-_=+!?~^" + "üé漢")
+        ws = [b"", b" ", b"  ", b"\t", b"\n", b"\r\n", b" \t "]
+
+        def w():
+            return rng.choice(ws)
+
+        checked_fast = 0
+        for _ in range(3000):
+            roll = rng.random()
+            if roll < 0.5:
+                # structured generation around the compiled shape
+                user = "".join(rng.choice(user_chars)
+                               for _ in range(rng.randrange(0, 24)))
+                num = rng.choice(
+                    [0, 1, -1, rng.randrange(-10**9, 10**9)])
+                body = (b"%s{%s\"user\"%s:%s\"%s\"%s,%s\"num\"%s:%s%d%s}%s"
+                        % (w(), w(), w(), w(), user.encode("utf-8"), w(),
+                           w(), w(), w(), num, w(), w()))
+            elif roll < 0.75:
+                # mutate a canonical body: flip/insert/delete one byte
+                body = bytearray(b'{"user": "abc", "num": 12}')
+                op = rng.randrange(3)
+                pos = rng.randrange(len(body))
+                if op == 0:
+                    body[pos] = rng.randrange(32, 127)
+                elif op == 1:
+                    body.insert(pos, rng.randrange(32, 127))
+                else:
+                    del body[pos]
+                body = bytes(body)
+            else:
+                # unstructured printable noise
+                body = bytes(rng.randrange(32, 127)
+                             for _ in range(rng.randrange(0, 48)))
+            fast = _parse_fast(body)
+            if fast is not None:
+                checked_fast += 1
+                # anything the fast path accepts, the generic parser
+                # accepts with the identical reading
+                assert _parse_generic(body) == fast, body
+        assert checked_fast > 500     # the fuzz actually hit the shape
