@@ -898,11 +898,60 @@ def bench_wire(u, i, r, n_users, n_items):
             _post(server.port, {"user": f"u{q}", "num": 10})   # warm
         fresh_qps = _hammer(False)
         reuse_qps = _hammer(True)
+        trace_qps = _trace_overhead_rounds(_hammer)
     finally:
         server.shutdown()
     emit("wire_fresh_dial_qps", fresh_qps, "qps", 1.0)
     emit("wire_keepalive_qps", reuse_qps, "qps",
          reuse_qps / fresh_qps)
+
+    # flight-recorder overhead gate: the keep-alive hammer three ways —
+    # hooks uninstalled (baseline), hooks installed with sampling off
+    # (the always-on stamp cost; gate <= 1%), and 1/64 head sampling
+    # (stamps + occasional materialization; gate <= 3%)
+    base_qps = trace_qps["off"]
+    for mode, budget in (("hooks", 0.01), ("sampled", 0.03)):
+        overhead = max(base_qps / max(trace_qps[mode], 1e-9) - 1.0, 0.0)
+        emit(f"wire_trace_overhead_{mode}", overhead * 100.0, "pct",
+             1.0 if overhead <= budget else budget / overhead)
+        if overhead > budget:
+            raise SystemExit(
+                f"wire: flight-recorder overhead ({mode}) "
+                f"{overhead * 100.0:.2f}% > {budget * 100.0:.0f}% gate "
+                f"(baseline {base_qps:.0f} qps, "
+                f"{mode} {trace_qps[mode]:.0f} qps)")
+
+
+def _trace_overhead_rounds(hammer, rounds=4):
+    """Best-of-`rounds` keep-alive qps per tracing mode, interleaved so
+    thermal/GC drift hits every mode equally: 'off' = wire hooks
+    cleared, 'hooks' = hooks installed with sample=0 (stamp slots only),
+    'sampled' = 1/64 head sampling. Restores the process tracing state
+    before returning."""
+    from predictionio_tpu.obs import trace
+    from predictionio_tpu.utils.wire import set_trace_hooks
+
+    modes = {
+        "off": lambda: set_trace_hooks(None, None),
+        "hooks": lambda: (trace.configure(sample=0.0),
+                          set_trace_hooks(trace.new_stamps,
+                                          trace.on_sent)),
+        "sampled": lambda: (trace.configure(sample=1.0 / 64.0),
+                            set_trace_hooks(trace.new_stamps,
+                                            trace.on_sent)),
+    }
+    best = {m: 0.0 for m in modes}
+    try:
+        for _ in range(rounds):
+            for mode, enter in modes.items():
+                enter()
+                best[mode] = max(best[mode], hammer(True))
+    finally:
+        # back to env-configured defaults + hooks installed (the state
+        # HTTPServerBase.start() leaves behind)
+        trace.configure()
+        set_trace_hooks(trace.new_stamps, trace.on_sent)
+    return best
 
 
 def bench_serving(u, i, r, n_users, n_items):

@@ -8,7 +8,8 @@ from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
     EngineInstanceStatus, EngineInstances, EvaluationInstance,
     EvaluationInstanceStatus, EvaluationInstances, EventStore, Lease, Leases,
-    Model, Models, StorageError, StorageWriteError, TenantQuota, TenantQuotas,
+    Model, Models, SLOObjective, SLOObjectives, StorageError,
+    StorageWriteError, TenantQuota, TenantQuotas,
 )
 from predictionio_tpu.data.storage.registry import (
     StorageRegistry, register_driver, set_default, storage,
@@ -18,7 +19,8 @@ __all__ = [
     "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
     "EngineInstance", "EngineInstanceStatus", "EngineInstances",
     "EvaluationInstance", "EvaluationInstanceStatus", "EvaluationInstances",
-    "EventStore", "Lease", "Leases", "Model", "Models", "StorageError",
+    "EventStore", "Lease", "Leases", "Model", "Models", "SLOObjective",
+    "SLOObjectives", "StorageError",
     "StorageWriteError", "TenantQuota", "TenantQuotas",
     "StorageRegistry", "register_driver", "set_default", "storage",
 ]

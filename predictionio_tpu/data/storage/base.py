@@ -209,6 +209,17 @@ class TenantQuota:
             weight=self.weight if self.weight is not None else other.weight)
 
 
+@dataclass(frozen=True)
+class SLOObjective:
+    """Per-app service-level objective override row (read by
+    `obs/slo.py`'s burn-rate tracker, the serving-side counterpart of
+    `TenantQuota`). None means 'inherit the server-wide default' from
+    PIO_SLO_LATENCY_MS / PIO_SLO_TARGET."""
+    appid: int
+    latency_ms: Optional[float] = None   # good-event latency threshold
+    target: Optional[float] = None       # availability objective, e.g. 0.999
+
+
 # ---------------------------------------------------------------------------
 # DAO interfaces
 # ---------------------------------------------------------------------------
@@ -411,6 +422,24 @@ class TenantQuotas(abc.ABC):
 
     @abc.abstractmethod
     def get_all(self) -> List[TenantQuota]: ...
+
+    @abc.abstractmethod
+    def delete(self, appid: int) -> None: ...
+
+
+class SLOObjectives(abc.ABC):
+    """Per-app SLO-override CRUD on the metadata store, read by the
+    serving SLO tracker (TTL-cached, like `TenantQuotas`)."""
+
+    @abc.abstractmethod
+    def upsert(self, slo: SLOObjective) -> None:
+        """Insert or fully replace the override row for `slo.appid`."""
+
+    @abc.abstractmethod
+    def get(self, appid: int) -> Optional[SLOObjective]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[SLOObjective]: ...
 
     @abc.abstractmethod
     def delete(self, appid: int) -> None: ...

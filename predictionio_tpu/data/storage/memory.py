@@ -17,7 +17,7 @@ from predictionio_tpu.data.event import Event, utcnow
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Lease, Model,
-    TenantQuota, _UNSET, match_event,
+    SLOObjective, TenantQuota, _UNSET, match_event,
 )
 
 
@@ -35,6 +35,7 @@ class MemStorageClient:
         self.models: Dict[str, Model] = {}
         self.leases: Dict[str, Lease] = {}
         self.tenant_quotas: Dict[int, TenantQuota] = {}
+        self.slo_objectives: Dict[int, SLOObjective] = {}
         # (app_id, channel_id) -> event_id -> Event
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
         self._app_seq = itertools.count(1)
@@ -246,6 +247,28 @@ class MemTenantQuotas(base.TenantQuotas):
     def delete(self, appid: int) -> None:
         with self.c.lock:
             self.c.tenant_quotas.pop(appid, None)
+
+
+class MemSLOObjectives(base.SLOObjectives):
+    def __init__(self, client: MemStorageClient):
+        self.c = client
+
+    def upsert(self, slo: SLOObjective) -> None:
+        with self.c.lock:
+            self.c.slo_objectives[slo.appid] = slo
+
+    def get(self, appid: int) -> Optional[SLOObjective]:
+        with self.c.lock:
+            return self.c.slo_objectives.get(appid)
+
+    def get_all(self) -> List[SLOObjective]:
+        with self.c.lock:
+            return [self.c.slo_objectives[k]
+                    for k in sorted(self.c.slo_objectives)]
+
+    def delete(self, appid: int) -> None:
+        with self.c.lock:
+            self.c.slo_objectives.pop(appid, None)
 
 
 class MemLeases(base.Leases):

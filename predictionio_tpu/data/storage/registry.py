@@ -53,6 +53,7 @@ def _register_builtin_drivers() -> None:
         "Models": memory.MemModels,
         "Leases": memory.MemLeases,
         "TenantQuotas": memory.MemTenantQuotas,
+        "SLOObjectives": memory.MemSLOObjectives,
         "Events": memory.MemEvents,
     })
     register_driver("SQLITE", sqlite.SQLiteStorageClient, {
@@ -64,6 +65,7 @@ def _register_builtin_drivers() -> None:
         "Models": sqlite.SQLiteModels,
         "Leases": sqlite.SQLiteLeases,
         "TenantQuotas": sqlite.SQLiteTenantQuotas,
+        "SLOObjectives": sqlite.SQLiteSLOObjectives,
         "Events": sqlite.SQLiteEvents,
     })
     register_driver("LOCALFS", localfs.LocalFSStorageClient, {
@@ -98,6 +100,7 @@ def _register_builtin_drivers() -> None:
             "EvaluationInstances": postgres.PostgresEvaluationInstances,
             "Models": postgres.PostgresModels,
             "TenantQuotas": postgres.PostgresTenantQuotas,
+            "SLOObjectives": postgres.PostgresSLOObjectives,
             "Events": postgres.PostgresEvents,
         })
 
@@ -342,6 +345,12 @@ class StorageRegistry:
         TenantQuotas DAO raise StorageError — the serving admission
         controller degrades to its env/CLI defaults with a warning."""
         return self._repo_dao("METADATA", "TenantQuotas")
+
+    def get_meta_data_slo_objectives(self) -> base.SLOObjectives:
+        """Per-app SLO-override DAO. Sources whose driver has no
+        SLOObjectives DAO raise StorageError — the SLO tracker degrades
+        to its env defaults."""
+        return self._repo_dao("METADATA", "SLOObjectives")
 
     def get_events(self) -> base.EventStore:
         """The LEvents/PEvents analog (training reads go through ingest/)."""

@@ -25,7 +25,7 @@ from predictionio_tpu.data.event import (
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
-    TenantQuota, _UNSET,
+    SLOObjective, TenantQuota, _UNSET,
     match_properties as _match_properties,
 )
 
@@ -70,6 +70,8 @@ META_DDL = (
     """CREATE TABLE IF NOT EXISTS tenant_quotas (
         appid INTEGER PRIMARY KEY, rate REAL, burst REAL,
         concurrency INTEGER, queue_max INTEGER, weight REAL)""",
+    """CREATE TABLE IF NOT EXISTS slo_objectives (
+        appid INTEGER PRIMARY KEY, latency_ms REAL, target REAL)""",
 )
 
 # Additive schema migrations for stores created before a column existed;
@@ -528,6 +530,42 @@ class SQLiteTenantQuotas(base.TenantQuotas):
         with self.c.lock, self.c.conn:
             self.c.conn.execute(
                 "DELETE FROM tenant_quotas WHERE appid=?", (appid,))
+
+
+class SQLiteSLOObjectives(base.SLOObjectives):
+    """Per-app SLO overrides; NULL columns inherit the server-wide
+    objective, so an operator can tighten only one app's latency."""
+
+    def __init__(self, client: SQLiteStorageClient):
+        self.c = client
+
+    _COLS = "appid, latency_ms, target"
+
+    def upsert(self, slo: SLOObjective) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                f"INSERT OR REPLACE INTO slo_objectives ({self._COLS}) "
+                "VALUES (?,?,?)",
+                (slo.appid, slo.latency_ms, slo.target))
+
+    def get(self, appid: int) -> Optional[SLOObjective]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                f"SELECT {self._COLS} FROM slo_objectives WHERE appid=?",
+                (appid,)).fetchone()
+        return SLOObjective(*row) if row else None
+
+    def get_all(self) -> List[SLOObjective]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                f"SELECT {self._COLS} FROM slo_objectives "
+                "ORDER BY appid").fetchall()
+        return [SLOObjective(*r) for r in rows]
+
+    def delete(self, appid: int) -> None:
+        with self.c.lock, self.c.conn:
+            self.c.conn.execute(
+                "DELETE FROM slo_objectives WHERE appid=?", (appid,))
 
 
 class SQLiteLeases(base.Leases):

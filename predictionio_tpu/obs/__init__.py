@@ -23,3 +23,9 @@ from predictionio_tpu.obs.jaxprobe import (  # noqa: F401
 from predictionio_tpu.obs.report import (  # noqa: F401
     record_train_phases, train_report,
 )
+from predictionio_tpu.obs.trace import (  # noqa: F401
+    TRACE_HEADER, PendingTrace, TraceRecorder, get_recorder,
+)
+from predictionio_tpu.obs.slo import (  # noqa: F401
+    SLOTracker, dao_overrides_loader,
+)

@@ -154,7 +154,8 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "bounds", "bucket_counts", "sum", "count")
+    __slots__ = ("_lock", "bounds", "bucket_counts", "sum", "count",
+                 "exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
         self._lock = lock
@@ -162,13 +163,50 @@ class _HistogramChild:
         self.bucket_counts = [0] * (len(bounds) + 1)   # last = +Inf
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (exemplar_id, value, unix ts); allocated on
+        # the first exemplar so untraced histograms pay nothing
+        self.exemplars: Optional[Dict[int, Tuple[str, float, float]]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         i = bisect_left(self.bounds, value)   # le-inclusive bucket
         with self._lock:
             self.bucket_counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (exemplar, value, time.time())
+
+    def exemplar_for_quantile(self, q: float
+                              ) -> Optional[Tuple[str, float, float]]:
+        """The stored exemplar nearest the bucket holding quantile `q`
+        (exact bucket first, then higher, then lower) — how the
+        dashboard links the p99 bucket of a latency histogram to a real
+        kept trace. None when no exemplar has been recorded."""
+        with self._lock:
+            if not self.exemplars:
+                return None
+            counts = list(self.bucket_counts)
+            total = self.count
+            ex = dict(self.exemplars)
+        if total <= 0:
+            return None
+        target = q * total
+        cum = 0
+        qi = len(counts) - 1
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target and c > 0:
+                qi = i
+                break
+        for i in range(qi, len(counts)):
+            if i in ex:
+                return ex[i]
+        for i in range(qi - 1, -1, -1):
+            if i in ex:
+                return ex[i]
+        return None
 
     class _Timer:
         __slots__ = ("_child", "_t0")
@@ -225,14 +263,17 @@ class Histogram(_Family):
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self._lock, self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        self._default().observe(value, exemplar=exemplar)
 
     def time(self):
         return self._default().time()
 
     def quantile(self, q: float) -> float:
         return self._default().quantile(q)
+
+    def exemplar_for_quantile(self, q: float):
+        return self._default().exemplar_for_quantile(q)
 
 
 class MetricsRegistry:
@@ -330,12 +371,23 @@ class MetricsRegistry:
             for key, child in fam._items():
                 labels = dict(zip(fam.labelnames, key))
                 if isinstance(child, _HistogramChild):
-                    series.append({
+                    row = {
                         "labels": labels, "count": child.count,
                         "sum": child.sum,
                         "p50": child.quantile(0.50),
                         "p90": child.quantile(0.90),
-                        "p99": child.quantile(0.99)})
+                        "p99": child.quantile(0.99)}
+                    with child._lock:
+                        ex = (dict(child.exemplars)
+                              if child.exemplars else None)
+                    if ex:
+                        bounds = child.bounds
+                        row["exemplars"] = [
+                            {"le": (_fmt(bounds[i]) if i < len(bounds)
+                                    else "+Inf"),
+                             "trace_id": t, "value": v, "ts": ts}
+                            for i, (t, v, ts) in sorted(ex.items())]
+                    series.append(row)
                 else:
                     series.append({"labels": labels, "value": child.value})
             snap[fam.name] = {"type": fam.kind, "help": fam.help,

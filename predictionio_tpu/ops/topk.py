@@ -71,7 +71,7 @@ HOST_CROSSOVER_CELLS = int(_os.environ.get(
 # program). Read by the bench to PROVE the device path ran, and by tests;
 # plain ints under the GIL (worst case a lost increment, never a wrong
 # path).
-DISPATCH_COUNTS = {"host": 0, "device": 0, "sharded": 0}
+DISPATCH_COUNTS = {"host": 0, "device": 0, "sharded": 0, "fused": 0}
 
 # Below this many score cells the amortized policy never promotes to the
 # device, whatever the EWMAs say: tiny unit-test-sized problems must stay
@@ -215,9 +215,23 @@ class DispatchPolicy:
 
 DISPATCH_POLICY = DispatchPolicy()
 
+# most recent dispatch path taken by any topk call — read by the
+# micro-batch drainer to tag member traces (host|device|sharded|fused).
+# A plain module global, not thread-local: multi-algorithm fan-out runs
+# predict in pool threads while the drainer reads from its own, and the
+# benign last-writer-wins race matches DISPATCH_COUNTS' semantics.
+_LAST_PATH = ""
+
+
+def last_dispatch() -> str:
+    """The dispatch path of the most recent topk call ("" before any)."""
+    return _LAST_PATH
+
 
 def _record_dispatch(path: str, cells: int,
                      seconds: Optional[float] = None) -> None:
+    global _LAST_PATH
+    _LAST_PATH = path
     DISPATCH_COUNTS[path] += 1
     try:
         _dispatch_total().labels(path=path).inc()
@@ -537,6 +551,9 @@ class BucketedTopK:
         # buckets served by the single-launch fused kernel (see
         # ops/fused_topk.py); the rest keep the XLA chain
         self.fused_buckets = 0
+        # which bucket sizes went fused, so dispatch attribution can
+        # tag "fused" vs "device" per call
+        self._fused_sizes: set = set()
 
     def warm(self) -> int:
         """AOT-lower/compile every bucket executable; returns how many
@@ -561,6 +578,7 @@ class BucketedTopK:
                 k=self.k, bucket=b, banned_width=self.banned_width)
             if exe is not None:
                 self.fused_buckets += 1
+                self._fused_sizes.add(b)
             else:
                 vec_spec = jax.ShapeDtypeStruct((b, self.rank),
                                                 np.float32)
@@ -633,8 +651,9 @@ class BucketedTopK:
             if len(bl):
                 banned[row, :len(bl)] = np.asarray(bl, np.int32)  # lint: ok
         scores, ixs = jax.device_get(exe(vecs, self.factors, banned))
-        _record_dispatch("device", bucket * self.n_items,
-                         time.perf_counter() - t0)
+        _record_dispatch(
+            "fused" if bucket in self._fused_sizes else "device",
+            bucket * self.n_items, time.perf_counter() - t0)
         return scores[:b], ixs[:b]
 
 

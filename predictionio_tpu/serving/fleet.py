@@ -72,6 +72,7 @@ from typing import Dict, List, Mapping, Optional, Sequence
 
 from predictionio_tpu.data.storage.base import Model, StorageError
 from predictionio_tpu.obs import MetricsRegistry, get_logger
+from predictionio_tpu.obs import trace
 from predictionio_tpu.resilience import (
     DeadlineExceeded, current_deadline, faults,
 )
@@ -84,9 +85,11 @@ from predictionio_tpu.utils.wire import HTTPConnectionPool
 _log = get_logger("serving.fleet")
 
 # headers forwarded verbatim to the replica (deadline propagation,
-# request-id correlation, auth)
+# request-id correlation, auth, trace context — the router's OWN
+# asserted X-PIO-Trace, layered via extra_headers, wins over a
+# client-supplied one)
 _FORWARD_HEADERS = ("X-PIO-Deadline-Ms", "X-Request-ID", "Authorization",
-                    "Content-Type", "X-PIO-App")
+                    "Content-Type", "X-PIO-App", "X-PIO-Trace")
 
 # reserved model-store id for the membership snapshot (per variant);
 # fsck's divergence sweep reports but never deletes unknown ids, so the
@@ -395,9 +398,26 @@ class FleetServer(HTTPServerBase):
         """/ready: the fleet serves while >=1 member is admitted."""
         admitted = [r.index for r in self._replicas
                     if r.admitted and r.running()]
-        return (bool(admitted),
-                {"replicas": len(self._replicas), "admitted": admitted,
-                 "leader": self._is_leader})
+        detail = {"replicas": len(self._replicas), "admitted": admitted,
+                  "leader": self._is_leader}
+        # worst-case SLO burn across the in-process replicas, so the
+        # router — the probe target operators actually watch — surfaces
+        # degradation without walking members (remote members carry
+        # their own /ready detail)
+        slo: Dict[str, dict] = {}
+        degraded = False
+        for rep in self._replicas:
+            if rep.server is None:
+                continue
+            for label, d in rep.server._slo.snapshot().items():
+                cur = slo.get(label)
+                if cur is None or d["burn_5m"] > cur["burn_5m"]:
+                    slo[label] = d
+            degraded = degraded or rep.server._slo.degraded()
+        if slo:
+            detail["slo"] = slo
+            detail["sloDegraded"] = degraded
+        return (bool(admitted), detail)
 
     # -- leadership ---------------------------------------------------------
     def is_leader(self) -> bool:
@@ -743,13 +763,21 @@ class FleetServer(HTTPServerBase):
         retried on the NEXT admitted member (zero failed client
         requests when a member dies), each failure feeding the
         ejection counter. Non-leaders redirect to the leader."""
+        p = trace.current()
         if not self._is_leader:
             leader = self._leader_hint
             if leader and leader != self._advertise:
                 self._fleet_obs["routed"].labels(outcome="redirected").inc()
+                hdrs = {"Location": f"http://{leader}{req.path}"}
+                if p is not None:
+                    # attach our trace context to the redirect so a
+                    # trace-aware client re-asserts it at the leader and
+                    # the two hops stitch under one trace id
+                    trace.annotate_pending(p, kind="router")
+                    hdrs[trace.TRACE_HEADER] = trace.child_header(p)
                 raise HTTPError(
                     307, f"not the fleet leader; try {leader}",
-                    headers={"Location": f"http://{leader}{req.path}"})
+                    headers=hdrs)
             raise HTTPError(503, "no fleet leader elected",
                             headers={"Retry-After": "1"})
         deadline = current_deadline()
@@ -774,10 +802,13 @@ class FleetServer(HTTPServerBase):
                 timeout = min(timeout, remaining)
             with rep.lock:
                 rep.inflight += 1
+            t_dial = time.perf_counter()
             try:
                 resp = self._proxy(rep, req, timeout, extra_headers)
             except OSError as e:
                 last_err = e
+                trace.add_span(p, f"proxy_retry:{rep.key}", t_dial,
+                               time.perf_counter())
                 self._record_failure(
                     rep, f"route error: {type(e).__name__}: {e}",
                     data_path=True)
@@ -786,6 +817,8 @@ class FleetServer(HTTPServerBase):
             finally:
                 with rep.lock:
                     rep.inflight -= 1
+            trace.add_span(p, f"proxy:{rep.key}", t_dial,
+                           time.perf_counter())
             if resp.status >= 500:
                 # the member answered; pass the response through but
                 # feed the error threshold (a member shedding 503s or
@@ -865,6 +898,7 @@ class FleetServer(HTTPServerBase):
                      f"the lease holder may run a rolling reload")
         if not self._reload_lock.acquire(blocking=False):
             raise HTTPError(409, "a rolling reload is already running")
+        t_roll = time.perf_counter()
         try:
             members = list(self._replicas)
             if only is not None:
@@ -944,6 +978,11 @@ class FleetServer(HTTPServerBase):
             report = {"results": results, "aborted": aborted}
             self._fleet_obs["rolls"].labels(
                 outcome="aborted" if aborted else "ok").inc()
+            rec = trace.get_recorder()
+            if rec.enabled:
+                rec.record_background(
+                    "rolling_reload", t_roll, time.perf_counter(),
+                    error="aborted" if aborted else "")
             _log.info("rolling_reload_done", aborted=aborted,
                       results=len(results))
             return report
@@ -963,6 +1002,17 @@ class FleetServer(HTTPServerBase):
                 # honoring, so only this router can mint identities
                 extra = ({TENANT_HEADER: self.admission.signed_header(tenant)}
                          if tenant is not None else None)
+                p = trace.current()
+                if p is not None:
+                    # the router's hop is kind=router (excluded from
+                    # pio_serve_seconds — the replica's serve entry owns
+                    # that observation) and asserts a signed child
+                    # context so replica spans stitch under our id
+                    trace.annotate_pending(
+                        p, kind="router",
+                        app=tenant.label if tenant is not None else "")
+                    extra = dict(extra or ())
+                    extra[trace.TRACE_HEADER] = trace.child_header(p)
                 return self._route(req, extra_headers=extra)
 
         @r.post("/fleet/register")

@@ -25,6 +25,7 @@ then DROP (counted) rather than block the queue forever.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import queue
 import random
@@ -46,6 +47,8 @@ from predictionio_tpu.core import (
 from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
+from predictionio_tpu.obs import trace
+from predictionio_tpu.obs.slo import SLOTracker, dao_overrides_loader
 from predictionio_tpu.resilience import (
     DEADLINE_HEADER, CircuitOpenError, Deadline, DeadlineExceeded,
     OverloadedError, RetryPolicy, call_with_retry, current_deadline,
@@ -482,8 +485,11 @@ class _MicroBatcher:
         # retire (predicate re-checked, spurious wakeups harmless)
         self._full = threading.Condition(self._lock)
         # per-tenant DRR lanes; each item: (deployment, query, done
-        # event, result slot, enqueue perf_counter, tenant label)
+        # event, result slot, enqueue perf_counter, tenant label,
+        # pending trace or None)
         self._queue = DRRQueue()
+        # links every member trace of one drained batch (batch_id)
+        self._batch_seq = itertools.count(1)
         self._draining = False
         self._closed = False
         self._delay_ewma = 0.0
@@ -552,21 +558,26 @@ class _MicroBatcher:
     def submit(self, deployment: _Deployment, query: Any,
                deadline: Optional[Deadline] = None,
                tenant: str = DEFAULT_TENANT, weight: float = 1.0,
-               tenant_queue_max: int = 0) -> Any:
+               tenant_queue_max: int = 0, pending=None) -> Any:
         return self.submit_slot(deployment, query, deadline=deadline,
                                 tenant=tenant, weight=weight,
-                                tenant_queue_max=tenant_queue_max)["result"]
+                                tenant_queue_max=tenant_queue_max,
+                                pending=pending)["result"]
 
     def submit_slot(self, deployment: _Deployment, query: Any,
                     deadline: Optional[Deadline] = None,
                     tenant: str = DEFAULT_TENANT, weight: float = 1.0,
-                    tenant_queue_max: int = 0) -> Dict[str, Any]:
+                    tenant_queue_max: int = 0,
+                    pending=None) -> Dict[str, Any]:
         """submit(), but returns the drained slot dict — "result" plus,
         when the batch encoder ran, the pre-serialized "wire" body the
-        fast path writes straight to the socket."""
+        fast path writes straight to the socket. `pending` is the
+        request's trace stamp slots (obs/trace.PendingTrace) or None;
+        the batcher stamps lane/exec/splice stages on it."""
         done = threading.Event()
         slot: Dict[str, Any] = {}
-        item = (deployment, query, done, slot, time.perf_counter(), tenant)
+        item = (deployment, query, done, slot, time.perf_counter(),
+                tenant, pending)
         with self._lock:
             if self._closed:
                 self.obs.shed.labels(surface="queries", app=tenant).inc()
@@ -616,6 +627,7 @@ class _MicroBatcher:
                     f"per-tenant micro-batch queue full "
                     f"({tenant_queue_max} pending)",
                     retry_after=max(self.window_s, 0.05))
+            trace.mark(pending, trace.S_ENQ)
             self.obs.queue_depth.set(float(len(self._queue)))
             if len(self._queue) >= self.batch_max:
                 self._full.notify()
@@ -663,12 +675,13 @@ class _MicroBatcher:
                         self._full.notify_all()
                         return
                     now = time.perf_counter()
-                    for _, _, _, _, t_enq, tenant in batch:
+                    for _, _, _, _, t_enq, tenant, pend in batch:
                         delay = max(now - t_enq, 0.0)
                         self.obs.queue_delay.observe(delay)
                         self._delay_ewma += self.DELAY_ALPHA * (
                             delay - self._delay_ewma)
                         self._queue.observe_delay(tenant, delay)
+                        trace.mark(pend, trace.S_DRAIN)
                 t0 = time.perf_counter()
                 self._process(batch)
                 dt = time.perf_counter() - t0
@@ -691,7 +704,7 @@ class _MicroBatcher:
                 self._draining = False
                 self._full.notify_all()
                 self.obs.queue_depth.set(0.0)
-            for _, _, done, slot, _, _ in stranded:
+            for _, _, done, slot, _, _, _ in stranded:
                 slot["error"] = e
                 done.set()
             _log.error("batch_drainer_crashed",
@@ -724,6 +737,7 @@ class _MicroBatcher:
             pow2 <<= 1
         with self._lock:
             self._size_counts[pow2] = self._size_counts.get(pow2, 0) + 1
+        from predictionio_tpu.ops.topk import last_dispatch
         # group by deployment (reload may swap mid-flight)
         by_dep: Dict[int, List] = {}
         for item in pending:
@@ -733,21 +747,33 @@ class _MicroBatcher:
             queries = [item[1] for item in items]
             try:
                 results = dep.predict_batch(queries)
+                disp = last_dispatch()
+                bid = next(self._batch_seq)
+                for item in items:
+                    p = item[6]
+                    if p is not None:
+                        trace.mark(p, trace.S_EXEC)
+                        p.batch_id = bid
+                        p.batch_size = len(items)
+                        if disp:
+                            p.dispatch = disp
                 wires: Optional[List[Optional[bytes]]] = None
                 if self.encoder is not None:
                     try:
                         wires = self.encoder(dep, results)
                     except Exception:
                         wires = None     # encoder bugs degrade, not fail
-                for i, ((_, _, done, slot, _, _), r) in enumerate(
+                for i, ((_, _, done, slot, _, _, p), r) in enumerate(
                         zip(items, results)):
                     slot["result"] = r
                     if wires is not None and wires[i] is not None:
                         slot["wire"] = wires[i]
+                    trace.mark(p, trace.S_SPLICE)
                     done.set()
             except Exception as e:
-                for _, _, done, slot, _, _ in items:
+                for _, _, done, slot, _, _, p in items:
                     slot["error"] = e
+                    trace.annotate_pending(p, error=type(e).__name__)
                     done.set()
 
 
@@ -779,6 +805,21 @@ class PredictionServer(HTTPServerBase):
                 else TenancyConfig.from_env())
         self.admission = AdmissionController(
             tcfg, registry=self.ctx.registry, metrics=self.metrics)
+        # per-app SLO burn rates (obs/slo.py); objectives come from env
+        # with per-app DAO overrides, the TenantQuotas pattern
+        self._slo = SLOTracker(
+            metrics=self.metrics,
+            loader=dao_overrides_loader(self.ctx.registry))
+        # end-to-end serve latency. With tracing ON the flight recorder
+        # observes this family itself (wire read -> wire write, with
+        # trace-id exemplars); these prebound children are the direct
+        # observation path when tracing is off, so the histogram exists
+        # either way.
+        self._serve_seconds = self.metrics.histogram(
+            "pio_serve_seconds",
+            "End-to-end serve latency (wire read to wire write)",
+            labels=("app",), buckets=trace.SERVE_BUCKETS)
+        self._ss0 = self._serve_seconds.labels(app="")
         self._engine_arg = engine
         self._dep: Optional[_Deployment] = None
         self._dep_lock = threading.Lock()
@@ -979,8 +1020,14 @@ class PredictionServer(HTTPServerBase):
             pass
         open_breakers = [s for s, st in states.items() if st == "open"]
         loaded = self._dep is not None
-        return (loaded and not open_breakers,
-                {"modelLoaded": loaded, "storageBreakers": states})
+        detail = {"modelLoaded": loaded, "storageBreakers": states}
+        # SLO burn is surfaced as degradation detail, never as a reason
+        # to pull the replica from rotation (a page, not an outage)
+        slo = self._slo.snapshot()
+        if slo:
+            detail["slo"] = slo
+            detail["sloDegraded"] = self._slo.degraded()
+        return (loaded and not open_breakers, detail)
 
     def current_instance_id(self) -> str:
         """Engine-instance id of the deployment currently serving, ""
@@ -1072,6 +1119,13 @@ class PredictionServer(HTTPServerBase):
     def _serve_one(self, query_json: Any,
                    tenant: Optional[TenantIdentity] = None) -> Any:
         t0 = time.perf_counter()
+        # the generic route's pending trace rides the contextvar set by
+        # _handle_raw; tag it as a serve entry so the recorder lands it
+        # in pio_serve_seconds (the router kind stays excluded)
+        p = trace.current()
+        trace.annotate_pending(
+            p, kind="serve",
+            app=tenant.label if tenant is not None else "")
         dep = self._dep
         with self._serve_obs.stage.labels(stage="extract").time():
             if dep.query_class is not None:
@@ -1083,9 +1137,11 @@ class PredictionServer(HTTPServerBase):
             prediction = self._batcher.submit(dep, query,
                                               deadline=current_deadline(),
                                               tenant=label, weight=weight,
-                                              tenant_queue_max=tqmax)
+                                              tenant_queue_max=tqmax,
+                                              pending=p)
         else:
             prediction = dep.predict_batch([query])[0]
+            trace.mark(p, trace.S_EXEC)
         # feedback loop + prId injection (CreateServer.scala:506-576)
         response_extra = {}
         if self.config.feedback:
@@ -1106,6 +1162,12 @@ class PredictionServer(HTTPServerBase):
             self.last_serving_sec = dt
             self.avg_serving_sec += (
                 (dt - self.avg_serving_sec) / self.request_count)
+        if p is None:
+            # tracing off (or legacy wire): observe serve latency here;
+            # with tracing on the recorder observes at wire write
+            app = tenant.label if tenant is not None else ""
+            (self._ss0 if not app
+             else self._serve_seconds.labels(app=app)).observe(dt)
         out = to_jsonable(prediction)
         if isinstance(out, dict):
             out.update(response_extra)
@@ -1139,6 +1201,9 @@ class PredictionServer(HTTPServerBase):
         t0 = time.perf_counter()
         rid = raw.header("X-Request-ID") or ""
         keep = raw.keep_alive
+        if raw.trace is not None:
+            trace.begin_raw(raw, raw.header(trace.TRACE_HEADER),
+                            kind="serve")
         tenant: Optional[TenantIdentity] = None
         admitted = False
         try:
@@ -1146,10 +1211,12 @@ class PredictionServer(HTTPServerBase):
                 deadline = deadline_from_header(
                     raw.header(DEADLINE_HEADER), self.default_deadline_ms)
             except ValueError as e:
-                return self._fast_finish(400, str(e), rid, keep, t0)
+                return self._fast_finish(400, str(e), rid, keep, t0,
+                                         raw=raw, tenant=tenant)
             if deadline is not None and deadline.expired:
                 return self._fast_finish(
-                    504, "deadline expired before processing", rid, keep, t0)
+                    504, "deadline expired before processing", rid, keep,
+                    t0, raw=raw, tenant=tenant)
             if self.admission.enabled:
                 tenant = self.admission.resolve_raw(
                     _scan_access_key(raw.query_string),
@@ -1157,20 +1224,24 @@ class PredictionServer(HTTPServerBase):
             with self._limiter:
                 admitted = True
                 with self.admission.admit(tenant):
+                    trace.stamp(raw, trace.S_AUTH)
                     label, weight, tqmax = \
                         self.admission.batch_params(tenant)
                     slot = batcher.submit_slot(
                         dep, dep.fast_ctor(user, int(m.group(2))),
                         deadline=deadline, tenant=label, weight=weight,
-                        tenant_queue_max=tqmax)
+                        tenant_queue_max=tqmax, pending=raw.trace)
         except HTTPError as e:
             return self._fast_finish(e.status, e.message, rid, keep, t0,
-                                     extra=e.headers or None)
+                                     extra=e.headers or None,
+                                     raw=raw, tenant=tenant)
         except DeadlineExceeded as e:
-            return self._fast_finish(504, str(e), rid, keep, t0)
+            return self._fast_finish(504, str(e), rid, keep, t0,
+                                     raw=raw, tenant=tenant)
         except CircuitOpenError as e:
             return self._fast_finish(503, str(e), rid, keep, t0,
-                                     retry_after=e.retry_after)
+                                     retry_after=e.retry_after,
+                                     raw=raw, tenant=tenant)
         except OverloadedError as e:
             if not admitted:
                 # the HTTP-plane inflight shed, counted exactly where
@@ -1178,14 +1249,18 @@ class PredictionServer(HTTPServerBase):
                 self._shed_counter.labels(
                     surface=self._limiter.surface, app="").inc()
             return self._fast_finish(e.status, e.message, rid, keep, t0,
-                                     retry_after=e.retry_after)
+                                     retry_after=e.retry_after,
+                                     raw=raw, tenant=tenant)
         except ValueError as e:
-            return self._fast_finish(400, str(e), rid, keep, t0)
+            return self._fast_finish(400, str(e), rid, keep, t0,
+                                     raw=raw, tenant=tenant)
         except Exception as e:
             _log.exception(
                 "unhandled_error", request_id=rid, method="POST",
-                path="/queries.json", error=f"{type(e).__name__}: {e}")
-            return self._fast_finish(500, str(e), rid, keep, t0)
+                path="/queries.json",
+                error=f"{type(e).__name__}: {e}")  # lint: ok (error path)
+            return self._fast_finish(500, str(e), rid, keep, t0,
+                                     raw=raw, tenant=tenant)
         wire = slot.get("wire")
         if wire is None:
             # the batch encoder declined (exotic result type): one
@@ -1193,6 +1268,7 @@ class PredictionServer(HTTPServerBase):
             wire = json.dumps(  # lint: ok (encoder-declined fallback)
                 to_jsonable(slot["result"])).encode("utf-8")
         dt = time.perf_counter() - t0
+        app = tenant.label if tenant is not None else ""
         if tenant is not None:
             self._serve_obs.tenant_serve.labels(
                 app=tenant.label).observe(dt)
@@ -1203,15 +1279,26 @@ class PredictionServer(HTTPServerBase):
                 (dt - self.avg_serving_sec) / self.request_count)
         self._fq_ok.inc()
         self._fq_hist.observe(dt)
+        self._slo.record(app, dt, ok=True)
+        trace.annotate(raw, status=200, app=app, route="/queries.json")
+        trace.stamp(raw, trace.S_DONE)
+        if raw.trace is None:
+            # tracing off: direct serve-latency observation (the
+            # recorder observes at wire write when tracing is on)
+            (self._ss0 if not app
+             else self._serve_seconds.labels(app=app)).observe(dt)
         return build_response(200, "application/json", wire, rid,
                               keep_alive=keep)
 
     def _fast_finish(self, status: int, message: str, rid: str,
                      keep: bool, t0: float, extra=None,
-                     retry_after: Optional[float] = None) -> bytes:
+                     retry_after: Optional[float] = None,
+                     raw: Optional[RawRequest] = None,
+                     tenant: Optional[TenantIdentity] = None) -> bytes:
         """Terminal encode for a fast-path non-200: same metrics the
         generic middleware would record, same JSON error envelope."""
         dt = time.perf_counter() - t0
+        app = tenant.label if tenant is not None else ""
         if retry_after is not None:
             extra = dict(extra or ())
             extra["Retry-After"] = str(max(1, round(retry_after)))
@@ -1220,6 +1307,14 @@ class PredictionServer(HTTPServerBase):
         self._req_counter.labels(route="/queries.json", method="POST",
                                  status=str(status)).inc()
         self._fq_hist.observe(dt)
+        self._slo.record(app, dt, ok=status < 500)
+        if raw is not None:
+            trace.annotate(raw, status=status, app=app,
+                           route="/queries.json", error=message)
+            trace.stamp(raw, trace.S_DONE)
+        if raw is None or raw.trace is None:
+            (self._ss0 if not app
+             else self._serve_seconds.labels(app=app)).observe(dt)
         body = b'{"message": ' + _json_str(message).encode("utf-8") + b'}'
         return build_response(status, "application/json", body, rid,
                               extra or None, keep_alive=keep)
@@ -1298,13 +1393,23 @@ class PredictionServer(HTTPServerBase):
             # charge the app's rate/concurrency quota (429 + Retry-After
             # over quota); tenancy off -> tenant is None, open serve
             tenant = self.admission.resolve(req)
-            with self.admission.admit(tenant):
-                try:
-                    payload = req.json()
-                except ValueError as e:
-                    raise HTTPError(400, str(e))
-                return Response.json(self._serve_one(payload,
-                                                     tenant=tenant))
+            app = tenant.label if tenant is not None else ""
+            t0 = time.perf_counter()
+            try:
+                with self.admission.admit(tenant):
+                    try:
+                        payload = req.json()
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                    resp = Response.json(self._serve_one(payload,
+                                                         tenant=tenant))
+            except Exception as e:
+                status = getattr(e, "status", 500)
+                self._slo.record(app, time.perf_counter() - t0,
+                                 ok=status < 500)
+                raise
+            self._slo.record(app, time.perf_counter() - t0, ok=True)
+            return resp
 
         @r.get("/")
         def index(req: Request) -> Response:

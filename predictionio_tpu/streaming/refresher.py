@@ -38,6 +38,7 @@ from typing import Dict, Optional, Tuple
 
 from predictionio_tpu.data.storage.base import DeltaInvalidated
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
+from predictionio_tpu.obs import trace
 from predictionio_tpu.resilience import faults
 from predictionio_tpu.streaming.delta import Delta, scan_delta
 from predictionio_tpu.streaming.updaters import FoldContext
@@ -112,7 +113,10 @@ class Refresher:
         in `pio_streaming_refresh_total`). Safe to call directly from
         tests — the loop is just pacing around this."""
         t0 = time.perf_counter()
-        outcome = self._tick_inner()
+        # background span: each tick (and the fold/rebuild inside it)
+        # lands in the trace ring as kind="background" when tracing is on
+        with trace.background("refresh_tick"):
+            outcome = self._tick_inner()
         self.last_outcome = outcome
         self._m["ticks"].labels(outcome=outcome).inc()
         self._m["tick_s"].observe(time.perf_counter() - t0)
@@ -188,6 +192,11 @@ class Refresher:
         if delta.empty:
             self._m["freshness"].set(0.0)
             return "noop"
+        with trace.background("refresh_fold"):
+            return self._fold_and_swap_inner(dep, delta, fctx)
+
+    def _fold_and_swap_inner(self, dep, delta: Delta,
+                             fctx: FoldContext) -> str:
         # phase 1 — compute ALL updated models host-side (no serving
         # impact; a crash here changes nothing the client sees)
         new_models = list(dep.models)

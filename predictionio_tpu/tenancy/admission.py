@@ -52,6 +52,7 @@ from typing import Callable, Mapping, Optional, Tuple
 
 from predictionio_tpu.data.storage.base import TenantQuota
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
+from predictionio_tpu.obs import trace
 from predictionio_tpu.resilience import OverloadedError
 from predictionio_tpu.utils.http import HTTPError, Request, \
     parse_basic_auth_value
@@ -487,6 +488,10 @@ class AdmissionController:
             if wait > 0.0:
                 self._shed.labels(surface="quota",
                                   app=tenant.label).inc()
+                # a quota shed never reaches the serve path, so tag the
+                # pending trace with the shedding app here (error/status
+                # land at response encode)
+                trace.annotate_pending(trace.current(), app=tenant.label)
                 raise OverloadedError(
                     f"app '{tenant.label}' over its rate quota "
                     f"({st.quota.rate:g} req/s)",
@@ -495,6 +500,7 @@ class AdmissionController:
             if cap > 0 and st.inflight >= cap:
                 self._shed.labels(surface="quota",
                                   app=tenant.label).inc()
+                trace.annotate_pending(trace.current(), app=tenant.label)
                 raise OverloadedError(
                     f"app '{tenant.label}' at its concurrency quota "
                     f"({cap} in flight)",

@@ -15,6 +15,7 @@ from typing import Optional
 from predictionio_tpu.core import RuntimeContext
 from predictionio_tpu.data.event import format_time
 from predictionio_tpu.obs import MetricsRegistry
+from predictionio_tpu.obs import trace as _trace
 from predictionio_tpu.utils.http import (
     HTTPServerBase, Request, Response,
 )
@@ -75,6 +76,13 @@ class Dashboard(HTTPServerBase):
         def metrics_html(req: Request) -> Response:
             self.auth.check(req)
             return Response(status=200, body=_metrics_page(self.metrics),
+                            content_type="text/html", headers=CORS_HEADERS)
+
+        @r.get("/traces.html")
+        def traces_html(req: Request) -> Response:
+            self.auth.check(req)
+            return Response(status=200,
+                            body=_traces_page(req.query_get),
                             content_type="text/html", headers=CORS_HEADERS)
 
         # the .json route must be registered first: routes match in order
@@ -144,6 +152,75 @@ _SERVING_PREFIXES = ("pio_topk_dispatch", "pio_jax_backend_compile",
 # state — the fairness/quota view of a shared fleet
 _TENANCY_PREFIXES = ("pio_tenant", "pio_shed_total")
 
+# wire-level transport families (selector front end): accepted/open
+# connections, request/response counts, bytes in each direction, send
+# failures, pipeline depth — the "is the socket layer healthy" view
+_WIRE_PREFIXES = ("pio_wire",)
+
+# SLO families: multi-window error-budget burn per app (burn > 1 eats
+# budget; burn >= 14.4 on the 5m window is the fast-burn page threshold)
+_SLO_PREFIXES = ("pio_slo",)
+
+
+def _wire_panel(snapshot: dict) -> str:
+    """Summary table of the wire transport families so an operator sees
+    connection churn, byte throughput, and send failures at a glance."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_WIRE_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Wire</h2>"
+                "<p>No wire activity recorded yet (selector wire off, "
+                "or no connections).</p>")
+    return ("<h2>Wire</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
+
+
+def _slo_panel(snapshot: dict) -> str:
+    """Error-budget burn per app and window, plus the p99 exemplar link:
+    the stored trace id nearest the pio_serve_seconds p99 bucket, linked
+    into the /traces.html waterfall so 'p99 regressed' resolves to a
+    real request in two clicks."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_SLO_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    links = []
+    serve = snapshot.get("pio_serve_seconds")
+    if serve:
+        for s in serve["series"]:
+            for ex in _nearest_exemplars(s):
+                app = s["labels"].get("app", "") or "(default)"
+                tid = html.escape(ex["trace_id"], quote=True)
+                links.append(
+                    f"<li>{html.escape(app)} p99&asymp;{s['p99']:.4g}s "
+                    f"&rarr; <a href='/traces.html?trace={tid}'>{tid}</a> "
+                    f"({ex['value']:.4g}s)</li>")
+    body = []
+    if rows:
+        body.append("<table border=1><tr><th>Family</th><th>Labels</th>"
+                    "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+                    + "</table>")
+    else:
+        body.append("<p>No SLO burn recorded yet (no traffic).</p>")
+    if links:
+        body.append("<p>p99 exemplars:</p><ul>" + "".join(links) + "</ul>")
+    return "<h2>SLO burn rate</h2>" + "".join(body)
+
+
+def _nearest_exemplars(series: dict) -> list:
+    """The exemplar row(s) nearest the series' p99 estimate (at most
+    one): exemplars are per-bucket, so the closest |value - p99| is the
+    one that actually lives in (or next to) the p99 bucket."""
+    exs = series.get("exemplars") or []
+    if not exs:
+        return []
+    p99 = series.get("p99", 0.0)
+    return [min(exs, key=lambda e: abs(e["value"] - p99))]
+
 
 def _tenancy_panel(snapshot: dict) -> str:
     """Summary table of the multi-tenant admission families: which app
@@ -212,9 +289,87 @@ def _metrics_page(metrics: MetricsRegistry) -> str:
         "<html><head><title>Metrics</title>"
         "<meta http-equiv='refresh' content='5'></head>"
         "<body><h1>Live metrics</h1>"
-        "<p>Prometheus text format: <a href='/metrics'>/metrics</a></p>"
-        + _serving_panel(snapshot) + _tenancy_panel(snapshot)
+        "<p>Prometheus text format: <a href='/metrics'>/metrics</a> "
+        "&middot; traces: <a href='/traces.html'>/traces.html</a></p>"
+        + _serving_panel(snapshot) + _slo_panel(snapshot)
+        + _wire_panel(snapshot) + _tenancy_panel(snapshot)
         + _durability_panel(snapshot) +
         "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
         "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")
+
+
+# -- trace waterfall ----------------------------------------------------------
+
+_BAR_PX = 600          # full-width pixel scale of one waterfall
+
+
+def _waterfall(entries: list) -> str:
+    """One trace's entries (router hop + replica serve share a trace_id)
+    rendered as horizontal bars on a common relative time axis. Entries
+    carry only relative span offsets, so hops are stacked in arrival
+    order, each with its own stage bars underneath."""
+    total = max((e.get("duration_ms", 0.0) for e in entries), default=0.0)
+    scale = _BAR_PX / total if total > 0 else 0.0
+    rows = []
+    for e in entries:
+        dur = e.get("duration_ms", 0.0)
+        label = (f"{e.get('kind', '')}:{e.get('name', '')} "
+                 f"[{e.get('app', '') or '-'}] status={e.get('status', 0)} "
+                 f"{e.get('dispatch', '') or ''} {dur:.3f}ms")
+        if e.get("error"):
+            label += f" error={e['error']}"
+        if e.get("batch_size"):
+            label += f" batch={e['batch_size']}"
+        rows.append(
+            f"<div><tt>{html.escape(label)}</tt></div>"
+            f"<div style='background:#36c;height:14px;"
+            f"width:{max(int(dur * scale), 2)}px'></div>")
+        for sp in e.get("spans", ()):
+            left = max(int(sp.get("start_ms", 0.0) * scale), 0)
+            width = max(int(sp.get("dur_ms", 0.0) * scale), 1)
+            rows.append(
+                f"<div style='margin-left:{left}px'>"
+                f"<div style='background:#9cf;height:10px;display:"
+                f"inline-block;width:{width}px'></div> "
+                f"<small>{html.escape(sp.get('name', ''))} "
+                f"{sp.get('dur_ms', 0.0):.3f}ms</small></div>")
+    head = entries[0]
+    tid = html.escape(head.get("trace_id", ""), quote=True)
+    return (f"<h3><a href='/traces.html?trace={tid}'>{tid}</a> "
+            f"&mdash; {total:.3f}ms, keep={html.escape(head.get('keep', ''))}"
+            "</h3>" + "".join(rows))
+
+
+def _traces_page(query_get) -> str:
+    """The `/traces.html` waterfall view over the in-process trace ring:
+    entries grouped by trace id (fleet hops stitch into one group), the
+    per-stage spans drawn to a shared scale. Filters mirror
+    `/traces.json`: ?app= &min_ms= &trace= &limit=."""
+    rec = _trace.get_recorder()
+    app = query_get("app")
+    min_ms = query_get("min_ms")
+    tid = query_get("trace")
+    limit = query_get("limit")
+    entries = rec.snapshot(
+        app=app if app else None,
+        min_ms=float(min_ms) if min_ms else None,
+        trace_id=tid if tid else None,
+        limit=int(limit) if limit else 50)
+    groups: dict = {}
+    for e in entries:                   # newest-first; keep that order
+        groups.setdefault(e.get("trace_id", ""), []).append(e)
+    sections = []
+    for gid, group in groups.items():
+        # within a trace, oldest entry first (router before replica)
+        sections.append(_waterfall(list(reversed(group))))
+    body = "".join(sections) if sections else (
+        "<p>No traces in the ring. Enable sampling with "
+        "PIO_TRACE_SAMPLE (errors and the slowest decile are kept "
+        "even at 0).</p>")
+    return (
+        "<html><head><title>Traces</title></head>"
+        "<body><h1>Flight recorder</h1>"
+        "<p>JSON: <a href='/traces.json'>/traces.json</a> &middot; "
+        "filters: ?app= &amp;min_ms= &amp;trace= &amp;limit=</p>"
+        + body + "</body></html>")

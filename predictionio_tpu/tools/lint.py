@@ -50,7 +50,10 @@ Checks, per source file:
     are spliced from pre-encoded fragments and headers are scanned in
     place. ``dict(...)`` constructor calls pass (rare, explicit);
     ``# lint: ok`` on the line is the escape hatch for documented
-    fallbacks (e.g. the encoder-declined single serialization)
+    fallbacks (e.g. the encoder-declined single serialization). The
+    same functions must not build f-strings per request, and may call
+    the flight recorder only through its stamp-slot API (stamp/mark/
+    begin_raw/annotate/...) — materialization belongs in on_sent
   - tenancy layers (tenancy/, serving/) must not grow tenant-keyed
     containers unboundedly — ``x[...] = ...`` / ``.setdefault(`` on a
     name containing ``tenant``/``lane`` is per-REMOTE-PRINCIPAL state:
@@ -115,6 +118,14 @@ _HOT_ROUTE_FILES = ("predictionio_tpu/serving/server.py",
                     "predictionio_tpu/utils/wire.py")
 _HOT_ROUTE_FUNCS = ("frame_request", "build_response", "header",
                     "_service", "_pump")
+
+# the flight-recorder calls allowed on the hot route: stamp-slot writes
+# and deferred annotation only — anything else (materialization, ring
+# access, id generation) allocates or locks per request and belongs in
+# on_sent/finish, which run after the response bytes are queued
+_HOT_TRACE_API = ("stamp", "mark", "begin_raw", "annotate",
+                  "annotate_pending", "add_span", "on_sent", "new_stamps",
+                  "current", "child_header", "ensure_ids")
 
 # container-name fragments the tenant-growth rule keys on
 _TENANT_NAME_FRAGMENTS = ("tenant", "lane")
@@ -500,6 +511,13 @@ def _check_hot_route(tree: ast.AST, text: str, rel: str) -> Iterator[str]:
                        f"'{node.name}' allocates per request; splice "
                        "pre-encoded fragments or scan in place (or "
                        "mark '# lint: ok')")
+            elif isinstance(sub, ast.JoinedStr):
+                if escaped(sub.lineno):
+                    continue
+                yield (f"{rel}:{sub.lineno}: f-string in hot-route "
+                       f"'{node.name}' formats per request; splice "
+                       "pre-encoded fragments (or mark '# lint: ok' "
+                       "for an error/fallback path)")
             elif isinstance(sub, ast.Call) \
                     and isinstance(sub.func, ast.Attribute) \
                     and sub.func.attr in ("dumps", "loads") \
@@ -512,6 +530,20 @@ def _check_hot_route(tree: ast.AST, text: str, rel: str) -> Iterator[str]:
                        "wire path; use the compiled shape match / "
                        "pre-encoded fragments (or mark '# lint: ok' "
                        "for a documented fallback)")
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == "trace" \
+                    and sub.func.attr not in _HOT_TRACE_API:
+                if escaped(sub.lineno):
+                    continue
+                yield (f"{rel}:{sub.lineno}: trace.{sub.func.attr}() in "
+                       f"hot-route '{node.name}' is outside the "
+                       "stamp-only API; hot paths may only write "
+                       "preallocated stamp slots "
+                       f"({', '.join(_HOT_TRACE_API)}) — "
+                       "materialization runs in on_sent (or mark "
+                       "'# lint: ok')")
 
 
 def _tenant_named(node: ast.AST) -> str:

@@ -55,12 +55,13 @@ from urllib.parse import parse_qs, unquote, urlparse
 from predictionio_tpu.obs import (
     MetricsRegistry, get_logger, get_registry, new_request_id,
 )
+from predictionio_tpu.obs import trace
 from predictionio_tpu.resilience import (
     DEADLINE_HEADER, Deadline, DeadlineExceeded, CircuitOpenError,
     InflightLimiter, OverloadedError, deadline_from_header, deadline_scope,
 )
 from predictionio_tpu.utils.wire import (
-    RawRequest, SelectorWire, build_response,
+    RawRequest, SelectorWire, build_response, set_trace_hooks,
 )
 
 _log = get_logger("http")
@@ -262,6 +263,10 @@ class HTTPServerBase:
         self.router.get("/metrics")(self._metrics_endpoint)
         self.router.get("/health")(self._health_endpoint)
         self.router.get("/ready")(self._ready_endpoint)
+        self.router.get("/traces.json")(self._traces_endpoint)
+        # last-seen absolute wire counters, so monotone pio_wire_*
+        # counters can be advanced by delta on each /metrics scrape
+        self._wire_last: Dict[str, float] = {}
         # hot-route hook (selector wire only): (method, path) -> a
         # handler taking the RAW framed request and returning complete
         # response bytes, or None to fall through to the Router path.
@@ -280,9 +285,86 @@ class HTTPServerBase:
         self._fast_routes[(method.upper(), path)] = fn
 
     def _metrics_endpoint(self, req: Request) -> Response:
+        self._sync_wire_metrics()
         return Response.text(
             self.metrics.render(),
             content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    def _traces_endpoint(self, req: Request) -> Response:
+        """The flight recorder's keep ring (filter: ?app= / ?min_ms= /
+        ?trace_id= / ?limit=)."""
+        return Response(status=200, body=trace.traces_json_body(
+            req.query_get), content_type="application/json")
+
+    def _sync_wire_metrics(self) -> None:
+        """Scrape the selector wire's raw counters into pio_wire_*
+        families (called on /metrics; the wire itself stays obs-free).
+        Monotone values advance their counter by delta since the last
+        scrape; instantaneous ones land in gauges."""
+        httpd = self._httpd
+        snap_fn = getattr(httpd, "stats_snapshot", None)
+        if snap_fn is None:
+            return
+        snap = snap_fn()
+        listen = f"{self.host}:{self.port}"
+        m = self.metrics
+        last = self._wire_last
+
+        def _cdelta(name: str, help_text: str, key: str, value: float,
+                    **extra) -> None:
+            prev = last.get(name + key + str(sorted(extra.items())), 0.0)
+            delta = value - prev
+            if delta > 0:
+                m.counter(name, help_text,
+                          labels=("listen",) + tuple(sorted(extra))
+                          ).labels(listen=listen, **extra).inc(delta)
+            last[name + key + str(sorted(extra.items()))] = value
+
+        _cdelta("pio_wire_connections_accepted_total",
+                "Connections accepted by the selector wire",
+                "accepted", float(snap["accepted"]))
+        _cdelta("pio_wire_requests_total",
+                "Requests framed off the selector wire",
+                "requests", float(snap["requests"]))
+        _cdelta("pio_wire_responses_total",
+                "Responses fully written by the selector wire",
+                "responses", float(snap["responses"]))
+        _cdelta("pio_wire_send_failures_total",
+                "Response writes that failed or timed out",
+                "send_failures", float(snap["send_failures"]))
+        _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
+                "bytes_in", float(snap["bytes_in"]), dir="in")
+        _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
+                "bytes_out", float(snap["bytes_out"]), dir="out")
+        for status, count in dict(snap["errors"]).items():
+            _cdelta("pio_wire_errors_total",
+                    "Wire-level framing error responses by status",
+                    f"err{status}", float(count), status=str(status))
+        gauges = (
+            ("pio_wire_connections_open",
+             "Connections currently registered with the reactor",
+             float(snap["open_conns"])),
+            ("pio_wire_queue_depth",
+             "Connections waiting for a wire worker",
+             float(snap["queue_depth"])),
+            ("pio_wire_workers_busy",
+             "Wire workers currently running a handler",
+             float(snap["busy_workers"])),
+            ("pio_wire_workers", "Wire worker pool size",
+             float(snap["workers"])),
+            ("pio_wire_pipeline_depth_hwm",
+             "High-water mark of framed-but-unserved pipelined requests "
+             "on one connection", float(snap["pipeline_hwm"])),
+        )
+        for name, help_text, value in gauges:
+            m.gauge(name, help_text,
+                    labels=("listen",)).labels(listen=listen).set(value)
+        reqs = float(snap["requests"])
+        reuse = (reqs - float(snap["accepted"])) / reqs if reqs > 0 else 0.0
+        m.gauge("pio_wire_keepalive_reuse_ratio",
+                "Fraction of requests that reused a kept-alive "
+                "connection", labels=("listen",)).labels(
+                    listen=listen).set(max(0.0, reuse))
 
     # -- health/readiness ---------------------------------------------------
     def readiness(self) -> Tuple[bool, Dict[str, Any]]:
@@ -342,8 +424,24 @@ class HTTPServerBase:
             query={k: v[0] for k, v in raw_q.items()},
             headers=dict(raw.header_items()), body=raw.body,
             client=raw.client, request_id=rid)
+        p = raw.trace
+        tok = None
+        if p is not None:
+            trace.begin_raw(raw, raw.header(trace.TRACE_HEADER))
+            p.rid = rid
+            # expose the pending trace to handlers below (fleet router
+            # spans, batcher submit on the legacy route)
+            tok = trace.set_current(p)
         started = time.perf_counter()
-        resp = self._handle(req)
+        try:
+            resp = self._handle(req)
+        finally:
+            if tok is not None:
+                trace.reset_current(tok)
+        if p is not None:
+            trace.annotate_pending(p, status=resp.status,
+                                   route=req.route or raw.path)
+            trace.mark(p, trace.S_DONE)
         self._observe_request(req, resp, time.perf_counter() - started)
         payload = resp.body
         if isinstance(payload, bytes):
@@ -449,6 +547,12 @@ class HTTPServerBase:
         want = os.environ.get("PIO_SERVE_WIRE", "selector").lower()
         use_selector = want != "threaded" and self._ssl_context is None
         self.wire = "selector" if use_selector else "threaded"
+        if use_selector:
+            # flight-recorder hooks: process-global and idempotent; the
+            # recorder reads PIO_TRACE_SAMPLE and returns None stamps
+            # when tracing is off, so this costs ~nothing by default
+            trace.get_recorder()
+            set_trace_hooks(trace.new_stamps, trace.on_sent)
 
         def _bind():
             if use_selector:

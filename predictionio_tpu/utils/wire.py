@@ -32,7 +32,19 @@ the same story — the fleet router proxies over reused
 
 Deliberately stdlib-only and obs-free: the observability middleware
 lives one layer up, and malformed-framing rejects (400/413/431/501) are
-answered from a static table before any route exists.
+answered from a static table before any route exists. Two narrow
+openings keep it that way without blinding the flight recorder:
+
+  - `set_trace_hooks(stamp_new, on_sent)` installs two opaque
+    callbacks (from `obs/trace.py`, via HTTPServerBase.start): one
+    allocates preallocated stamp slots onto `RawRequest.trace` as a
+    request is framed, the other fires after the response bytes hit
+    the socket. Both are None by default and the hot path checks one
+    global before paying anything — tracing off costs two loads.
+  - `SelectorWire.stats` counts raw wire activity (accepts, framed
+    requests, bytes, pipeline high-water, busy workers) as plain ints;
+    the obs layer scrapes `stats_snapshot()` into `pio_wire_*`
+    families on /metrics. No metrics objects live here.
 """
 
 from __future__ import annotations
@@ -63,6 +75,23 @@ _SEND_TIMEOUT_S = 30.0
 
 RawHandler = Callable[["RawRequest"], Tuple[bytes, bool]]
 
+# Tracing hooks (obs/trace.py), installed by the obs layer via
+# set_trace_hooks(). None = tracing off; the wire never imports obs.
+_STAMP_NEW: Optional[Callable[[float], object]] = None
+_ON_SENT: Optional[Callable[["RawRequest"], None]] = None
+
+
+def set_trace_hooks(stamp_new: Optional[Callable[[float], object]],
+                    on_sent: Optional[Callable[["RawRequest"], None]]
+                    ) -> None:
+    """Install (or clear, with Nones) the flight-recorder hooks:
+    `stamp_new(t_first_read) -> trace-or-None` runs as a request is
+    framed, `on_sent(raw)` after its response bytes are on the
+    socket."""
+    global _STAMP_NEW, _ON_SENT
+    _STAMP_NEW = stamp_new
+    _ON_SENT = on_sent
+
 _REASONS = http.client.responses
 _STATUS_LINES: Dict[int, bytes] = {
     code: (f"HTTP/1.1 {code} {reason}\r\n".encode("ascii"))
@@ -83,7 +112,7 @@ class RawRequest:
     legacy path materializes a dict via `header_items()`."""
 
     __slots__ = ("method", "target", "path", "query_string", "head",
-                 "body", "keep_alive", "client", "_lhead")
+                 "body", "keep_alive", "client", "trace", "_lhead")
 
     def __init__(self, method: str, target: str, head: bytes,
                  client: str = ""):
@@ -96,6 +125,7 @@ class RawRequest:
         self.body = b""
         self.keep_alive = True
         self.client = client
+        self.trace = None         # PendingTrace stamp slots (obs/trace.py)
         self._lhead: Optional[bytes] = None
 
     def header(self, name: str) -> Optional[str]:
@@ -149,7 +179,8 @@ def build_response(status: int, content_type: str, body: bytes,
         parts.append(b"X-Request-ID: " + rid.encode("latin-1") + b"\r\n")
     if extra:
         for k, v in extra.items():
-            parts.append(f"{k}: {v}\r\n".encode("latin-1"))
+            parts.append(k.encode("latin-1") + b": "
+                         + v.encode("latin-1") + b"\r\n")
     if not keep_alive:
         parts.append(b"Connection: close\r\n")
     parts.append(b"\r\n")
@@ -223,7 +254,7 @@ def frame_request(buf: bytearray, client: str = ""
 
 class _Conn:
     __slots__ = ("sock", "fd", "client", "buf", "pending", "busy",
-                 "closing", "last_active", "lock")
+                 "closing", "last_active", "lock", "t_read")
 
     def __init__(self, sock: socket.socket, client: str):
         self.sock = sock
@@ -236,6 +267,30 @@ class _Conn:
         self.closing = False
         self.last_active = time.monotonic()
         self.lock = threading.Lock()
+        self.t_read = 0.0          # first-read stamp for the next request
+
+
+class WireStats:
+    """Raw wire activity counters: plain ints, no metrics objects, so
+    the wire stays obs-free. Reactor-owned fields (accepted, requests,
+    bytes_in, pipeline_hwm, errors) are written by the reactor thread
+    only; `lock` guards the worker-side fields."""
+
+    __slots__ = ("accepted", "requests", "bytes_in", "pipeline_hwm",
+                 "errors", "lock", "bytes_out", "responses",
+                 "send_failures", "busy_workers")
+
+    def __init__(self):
+        self.accepted = 0
+        self.requests = 0
+        self.bytes_in = 0
+        self.pipeline_hwm = 0
+        self.errors: Dict[int, int] = {}   # WireError status -> count
+        self.lock = threading.Lock()
+        self.bytes_out = 0
+        self.responses = 0
+        self.send_failures = 0
+        self.busy_workers = 0
 
 
 class SelectorWire:
@@ -251,6 +306,7 @@ class SelectorWire:
         self._lifecycle = threading.Lock()
         self._conns: Dict[int, _Conn] = {}
         self._to_close: Deque[_Conn] = deque()
+        self.stats = WireStats()
         if workers <= 0:
             # Workers BLOCK in the handler (device step, store reads),
             # they are not CPU-bound — size the pool to cover the
@@ -329,10 +385,15 @@ class SelectorWire:
                 pass
             conn = _Conn(sock, addr[0] if addr else "")
             self._conns[conn.fd] = conn
+            self.stats.accepted += 1
             self._sel.register(sock, selectors.EVENT_READ, conn)
 
     def _on_readable(self, conn: _Conn) -> None:
         eof = False
+        if not conn.buf and _STAMP_NEW is not None:
+            # first bytes of the next request on this connection
+            conn.t_read = time.perf_counter()
+        n_in = 0
         try:
             while True:
                 data = conn.sock.recv(_RECV_CHUNK)
@@ -340,12 +401,14 @@ class SelectorWire:
                     eof = True
                     break
                 conn.buf.extend(data)
+                n_in += len(data)
                 if len(data) < _RECV_CHUNK:
                     break
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
             eof = True
+        self.stats.bytes_in += n_in
         conn.last_active = time.monotonic()
         if conn.buf:
             self._pump(conn)
@@ -361,10 +424,12 @@ class SelectorWire:
         """Frame every complete request in the buffer (up to the
         pipeline cap) and hand the connection to a worker."""
         added = False
+        st = self.stats
         while len(conn.pending) < PIPELINE_MAX:
             try:
                 raw, consumed = frame_request(conn.buf, conn.client)
             except WireError as e:
+                st.errors[e.status] = st.errors.get(e.status, 0) + 1
                 with conn.lock:
                     conn.pending.append(("err", _error_bytes(e)))
                     conn.closing = True
@@ -374,8 +439,15 @@ class SelectorWire:
             if raw is None:
                 break
             del conn.buf[:consumed]
+            sn = _STAMP_NEW
+            if sn is not None:
+                raw.trace = sn(conn.t_read)
+            st.requests += 1
             with conn.lock:
                 conn.pending.append(("req", raw))
+                depth = len(conn.pending)
+            if depth > st.pipeline_hwm:
+                st.pipeline_hwm = depth
             added = True
         if added:
             with conn.lock:
@@ -420,11 +492,18 @@ class SelectorWire:
 
     # -- workers -------------------------------------------------------------
     def _worker_loop(self) -> None:
+        st = self.stats
         while True:
             conn = self._workq.get()
             if conn is None:
                 return
-            self._service(conn)
+            with st.lock:
+                st.busy_workers += 1
+            try:
+                self._service(conn)
+            finally:
+                with st.lock:
+                    st.busy_workers -= 1
 
     def _service(self, conn: _Conn) -> None:
         """Serve this connection's framed requests in order; the busy
@@ -448,7 +527,14 @@ class SelectorWire:
                     500, "application/json",
                     b'{"message": "internal wire error"}',
                     keep_alive=False), True
-            if not self._send(conn, data) or close or not item.keep_alive:
+            sent = self._send(conn, data)
+            cb = _ON_SENT
+            if sent and cb is not None and item.trace is not None:
+                try:
+                    cb(item)
+                except Exception:
+                    pass               # tracing must never kill a worker
+            if not sent or close or not item.keep_alive:
                 self._request_close(conn)
                 return
             conn.last_active = time.monotonic()
@@ -461,6 +547,7 @@ class SelectorWire:
         mv = memoryview(data)
         end = time.monotonic() + _SEND_TIMEOUT_S
         sock = conn.sock
+        st = self.stats
         while mv:
             try:
                 n = sock.send(mv)
@@ -468,13 +555,22 @@ class SelectorWire:
             except (BlockingIOError, InterruptedError):
                 remaining = end - time.monotonic()
                 if remaining <= 0:
+                    with st.lock:
+                        st.send_failures += 1
                     return False
                 try:
                     select.select([], [sock], [], min(remaining, 1.0))
                 except (OSError, ValueError):
+                    with st.lock:
+                        st.send_failures += 1
                     return False
             except OSError:
+                with st.lock:
+                    st.send_failures += 1
                 return False
+        with st.lock:
+            st.bytes_out += len(data)
+            st.responses += 1
         return True
 
     def _request_close(self, conn: _Conn) -> None:
@@ -488,6 +584,28 @@ class SelectorWire:
             pass
         self._to_close.append(conn)
         self._wake()
+
+    def stats_snapshot(self) -> Dict[str, object]:
+        """Point-in-time wire counters for the obs layer's pio_wire_*
+        families. Reactor-owned fields are read without the lock —
+        single int reads are atomic enough for monitoring."""
+        st = self.stats
+        with st.lock:
+            out: Dict[str, object] = {
+                "bytes_out": st.bytes_out,
+                "responses": st.responses,
+                "send_failures": st.send_failures,
+                "busy_workers": st.busy_workers,
+            }
+        out["accepted"] = st.accepted
+        out["requests"] = st.requests
+        out["bytes_in"] = st.bytes_in
+        out["pipeline_hwm"] = st.pipeline_hwm
+        out["errors"] = dict(st.errors)
+        out["open_conns"] = len(self._conns)
+        out["queue_depth"] = self._workq.qsize()
+        out["workers"] = self._n_workers
+        return out
 
     # -- lifecycle -----------------------------------------------------------
     def shutdown(self) -> None:

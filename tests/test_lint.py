@@ -384,6 +384,53 @@ def test_hot_route_gate_scoped_to_wire_files(tmp_path):
     assert not lint.run(tmp_path)
 
 
+def test_hot_route_gate_catches_fstrings_and_trace_materialization(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.obs import trace\n"
+        "def _fast_queries(raw, rid):\n"
+        "    tag = f'req-{rid}'\n"
+        "    trace.traces_json_body(raw.query_get)\n"
+        "    return tag\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "f-string in hot-route '_fast_queries'" in kinds
+    assert "trace.traces_json_body() in hot-route '_fast_queries'" in kinds
+    assert "stamp-only API" in kinds
+
+
+def test_hot_route_gate_allows_stamp_api_and_escapes(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "utils" / "wire.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.obs import trace\n"
+        "def _fast_queries(raw, e):\n"
+        "    trace.stamp(raw, trace.S_EXEC)\n"     # stamp-only API: fine
+        "    trace.annotate(raw, dispatch='host')\n"
+        "    msg = f'{type(e).__name__}: {e}'  # lint: ok (error path)\n"
+        "    return msg\n"
+        "def render(rid):\n"                       # not a hot-route function
+        "    return f'req-{rid}'\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_hot_route_trace_gate_scoped_to_wire_files(tmp_path):
+    # trace materialization outside the wire files is the normal API
+    ok = tmp_path / "predictionio_tpu" / "tools" / "page.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "from predictionio_tpu.obs import trace\n"
+        "def _fast_render(q):\n"
+        "    return trace.traces_json_body(q), f'n={len(q)}'\n"
+    )
+    assert not lint.run(tmp_path)
+
+
 def test_tenant_growth_gate_catches_unbounded_maps(tmp_path):
     bad = tmp_path / "predictionio_tpu" / "tenancy" / "leaky.py"
     bad.parent.mkdir(parents=True)
