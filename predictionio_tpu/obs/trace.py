@@ -90,7 +90,7 @@ class PendingTrace:
 
     __slots__ = ("st", "trace_id", "span_id", "parent_id", "sampled",
                  "kind", "app", "route", "status", "dispatch", "error",
-                 "batch_id", "batch_size", "rid", "extra")
+                 "batch_id", "batch_size", "rid", "extra", "reactor")
 
     def __init__(self):
         self.st = [0.0] * N_STAMPS
@@ -108,6 +108,7 @@ class PendingTrace:
         self.batch_size = 0
         self.rid = ""
         self.extra = None        # optional [(name, t0, t1), ...]
+        self.reactor = -1        # accept-shard index (set by the wire)
 
 
 # -- X-PIO-Trace codec (signed-header compatible with X-PIO-App) -------------
@@ -315,6 +316,8 @@ class TraceRecorder:
             entry["error"] = p.error
         if p.rid:
             entry["request_id"] = p.rid
+        if p.reactor >= 0:
+            entry["reactor"] = p.reactor
         return entry
 
     def _slow_log(self, p: PendingTrace, dur: float) -> None:

@@ -74,7 +74,7 @@ from predictionio_tpu.data.storage.base import Model, StorageError
 from predictionio_tpu.obs import MetricsRegistry, get_logger
 from predictionio_tpu.obs import trace
 from predictionio_tpu.resilience import (
-    DeadlineExceeded, current_deadline, faults,
+    DeadlineExceeded, OverloadedError, current_deadline, faults,
 )
 from predictionio_tpu.serving.server import PredictionServer, ServerConfig
 from predictionio_tpu.utils.http import (
@@ -995,9 +995,34 @@ class FleetServer(HTTPServerBase):
 
         @r.post("/queries.json")
         def queries(req: Request) -> Response:
+            # Admission is resolved AND charged before any routing
+            # decision — a standby that 307-redirects has already spent
+            # the rate token (the _AdmitGuard releases only the
+            # concurrency slot), so N standbys cannot admit N x rate
+            # during a handoff window. Locked by the regression test in
+            # tests/test_tenancy.py. Bodies proxy as opaque bytes with
+            # Content-Type forwarded, so binary-framed queries
+            # (application/x-pio-bin) ride through unchanged.
             from predictionio_tpu.tenancy import TENANT_HEADER
             tenant = self.admission.resolve(req)
-            with self.admission.admit(tenant):
+            try:
+                guard = self.admission.admit(tenant)
+            except OverloadedError as e:
+                # shed at a standby: still tell the client where the
+                # leader is, so handoff-window retries go to the node
+                # that will actually serve them
+                leader = self._leader_hint
+                if (not self._is_leader and leader
+                        and leader != self._advertise):
+                    raise HTTPError(
+                        e.status, e.message,
+                        headers={
+                            "Retry-After":
+                                str(max(1, round(e.retry_after))),
+                            "Location": f"http://{leader}{req.path}",
+                        })
+                raise
+            with guard:
                 # HMAC-signed assertion: replicas verify before
                 # honoring, so only this router can mint identities
                 extra = ({TENANT_HEADER: self.admission.signed_header(tenant)}

@@ -64,7 +64,9 @@ from predictionio_tpu.tenancy import (
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
 )
-from predictionio_tpu.utils.wire import RawRequest, build_response
+from predictionio_tpu.utils.wire import (
+    BIN_CONTENT_TYPE, RawRequest, build_response, decode_bin_query,
+)
 
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                       256.0, 512.0)
@@ -478,6 +480,11 @@ class _MicroBatcher:
         self.encoder: Optional[
             Callable[[Any, Sequence[Any]],
                      Optional[List[Optional[bytes]]]]] = None
+        # optional cross-wakeup to the wire: called once after every
+        # drained batch completes, so the reactors can flush deferred
+        # pipelined responses at the batch boundary instead of waiting
+        # for each owning worker (SelectorWire.flush_hint)
+        self.drain_hook: Optional[Callable[[], None]] = None
         self._lock = threading.Lock()
         # wakes the drainer the moment a full batch forms, so a batch
         # that fills mid-window ships immediately instead of sleeping
@@ -775,6 +782,12 @@ class _MicroBatcher:
                     slot["error"] = e
                     trace.annotate_pending(p, error=type(e).__name__)
                     done.set()
+        hook = self.drain_hook
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass           # a wire nudge must never kill the drainer
 
 
 class PredictionServer(HTTPServerBase):
@@ -1074,6 +1087,14 @@ class PredictionServer(HTTPServerBase):
                     pass
         return super().start(background)
 
+    def _on_bound(self) -> None:
+        if self._batcher is not None:
+            # cross-wakeup: a completed batch drain nudges the wire
+            # reactors to flush deferred pipelined responses (None on
+            # the threaded wire — the hook stays unset there)
+            self._batcher.drain_hook = getattr(
+                self._httpd, "flush_hint", None)
+
     def stop(self) -> None:
         """Graceful shutdown: drain the micro-batcher (accepted
         requests finish; new submits shed 503), flush the feedback
@@ -1192,12 +1213,28 @@ class PredictionServer(HTTPServerBase):
                 or self.plugin_context.output_sniffers:
             return None
         m = _FAST_QUERY_RE.match(raw.body)
-        if m is None:
-            return None
-        try:
-            user = m.group(1).decode("utf-8")
-        except UnicodeDecodeError:
-            return None
+        if m is not None:
+            try:
+                user = m.group(1).decode("utf-8")
+            except UnicodeDecodeError:
+                return None
+            num = int(m.group(2))
+        else:
+            # binary SDK framing: the same {"user", "num"} query as a
+            # msgpack-subset map (Content-Type: application/x-pio-bin)
+            # decoded by direct byte indexing — no JSON at all. A
+            # malformed binary frame is a terminal 400 here: the
+            # generic Router fallback only speaks JSON.
+            ct = raw.header("Content-Type")
+            if ct is None or not ct.startswith(BIN_CONTENT_TYPE):
+                return None
+            decoded = decode_bin_query(raw.body)
+            if decoded is None:
+                return self._fast_finish(
+                    400, "malformed binary query frame",
+                    raw.header("X-Request-ID") or "", raw.keep_alive,
+                    time.perf_counter(), raw=raw)
+            user, num = decoded
         t0 = time.perf_counter()
         rid = raw.header("X-Request-ID") or ""
         keep = raw.keep_alive
@@ -1228,7 +1265,7 @@ class PredictionServer(HTTPServerBase):
                     label, weight, tqmax = \
                         self.admission.batch_params(tenant)
                     slot = batcher.submit_slot(
-                        dep, dep.fast_ctor(user, int(m.group(2))),
+                        dep, dep.fast_ctor(user, num),
                         deadline=deadline, tenant=label, weight=weight,
                         tenant_queue_max=tqmax, pending=raw.trace)
         except HTTPError as e:

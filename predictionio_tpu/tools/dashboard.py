@@ -162,9 +162,51 @@ _WIRE_PREFIXES = ("pio_wire",)
 _SLO_PREFIXES = ("pio_slo",)
 
 
+def _reactor_balance(snapshot: dict) -> str:
+    """Per-reactor connection/request balance: one row per accept
+    shard, with each shard's share of total framed requests, so
+    SO_REUSEPORT (or round-robin handoff) skew is visible at a glance.
+    Empty string when the wire runs a single unlabeled reactor."""
+    per: dict = {}
+
+    def gather(family: str, key: str) -> None:
+        fam = snapshot.get(family)
+        if not fam:
+            return
+        for s in fam["series"]:
+            r = s["labels"].get("reactor")
+            if r is None:
+                continue
+            d = per.setdefault(r, {})
+            d[key] = d.get(key, 0.0) + s["value"]
+
+    gather("pio_wire_requests_total", "requests")
+    gather("pio_wire_connections_accepted_total", "accepted")
+    gather("pio_wire_connections_open", "open")
+    if len(per) < 2:
+        return ""
+    total_req = sum(v.get("requests", 0.0) for v in per.values()) or 1.0
+    rows = []
+    for r in sorted(per, key=lambda x: (len(x), x)):
+        v = per[r]
+        share = 100.0 * v.get("requests", 0.0) / total_req
+        rows.append(
+            f"<tr><td>{html.escape(r)}</td>"
+            f"<td>{v.get('accepted', 0.0):.0f}</td>"
+            f"<td>{v.get('open', 0.0):.0f}</td>"
+            f"<td>{v.get('requests', 0.0):.0f}</td>"
+            f"<td>{share:.1f}%</td></tr>")
+    return ("<h3>Reactor balance</h3>"
+            "<table border=1><tr><th>Reactor</th><th>Accepted</th>"
+            "<th>Open</th><th>Requests</th><th>Share</th></tr>"
+            + "".join(rows) + "</table>")
+
+
 def _wire_panel(snapshot: dict) -> str:
     """Summary table of the wire transport families so an operator sees
-    connection churn, byte throughput, and send failures at a glance."""
+    connection churn, byte throughput, and send failures at a glance —
+    plus the per-reactor accept-shard balance when the wire runs more
+    than one reactor."""
     rows = []
     for name, fam in sorted(snapshot.items()):
         if name.startswith(_WIRE_PREFIXES):
@@ -173,8 +215,8 @@ def _wire_panel(snapshot: dict) -> str:
         return ("<h2>Wire</h2>"
                 "<p>No wire activity recorded yet (selector wire off, "
                 "or no connections).</p>")
-    return ("<h2>Wire</h2>"
-            "<table border=1><tr><th>Family</th><th>Labels</th>"
+    return ("<h2>Wire</h2>" + _reactor_balance(snapshot)
+            + "<table border=1><tr><th>Family</th><th>Labels</th>"
             "<th>Type</th><th>Value</th></tr>" + "".join(rows)
             + "</table>")
 

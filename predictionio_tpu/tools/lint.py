@@ -117,7 +117,13 @@ _TENANCY_DIRS = ("predictionio_tpu/tenancy/", "predictionio_tpu/serving/")
 _HOT_ROUTE_FILES = ("predictionio_tpu/serving/server.py",
                     "predictionio_tpu/utils/wire.py")
 _HOT_ROUTE_FUNCS = ("frame_request", "build_response", "header",
-                    "_service", "_pump")
+                    "_service", "_pump",
+                    # sendmsg egress + cross-wakeup batch flush
+                    "_flush_out", "_flush_locked", "_mark_sent",
+                    "flush_hint", "_flush_pass",
+                    # binary query framing (SDK fast lane)
+                    "encode_bin_query", "decode_bin_query",
+                    "_decode_bin_slow")
 
 # the flight-recorder calls allowed on the hot route: stamp-slot writes
 # and deferred annotation only — anything else (materialization, ring

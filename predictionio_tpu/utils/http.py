@@ -61,7 +61,8 @@ from predictionio_tpu.resilience import (
     InflightLimiter, OverloadedError, deadline_from_header, deadline_scope,
 )
 from predictionio_tpu.utils.wire import (
-    RawRequest, SelectorWire, build_response, set_trace_hooks,
+    RawRequest, SelectorWire, ShardedWire, build_response,
+    reactor_count, set_trace_hooks,
 )
 
 _log = get_logger("http")
@@ -300,12 +301,18 @@ class HTTPServerBase:
         """Scrape the selector wire's raw counters into pio_wire_*
         families (called on /metrics; the wire itself stays obs-free).
         Monotone values advance their counter by delta since the last
-        scrape; instantaneous ones land in gauges."""
+        scrape; instantaneous ones land in gauges. Every family carries
+        a `reactor` label — one series per accept shard under
+        ShardedWire ("0" for the single-reactor wire), so shard skew is
+        visible straight from /metrics."""
         httpd = self._httpd
         snap_fn = getattr(httpd, "stats_snapshot", None)
         if snap_fn is None:
             return
         snap = snap_fn()
+        # ShardedWire returns the aggregate plus per-reactor snapshots;
+        # a plain SelectorWire snapshot IS its own single shard
+        shards = snap.get("reactors") or [snap]
         listen = f"{self.host}:{self.port}"
         m = self.metrics
         last = self._wire_last
@@ -320,51 +327,65 @@ class HTTPServerBase:
                           ).labels(listen=listen, **extra).inc(delta)
             last[name + key + str(sorted(extra.items()))] = value
 
-        _cdelta("pio_wire_connections_accepted_total",
-                "Connections accepted by the selector wire",
-                "accepted", float(snap["accepted"]))
-        _cdelta("pio_wire_requests_total",
-                "Requests framed off the selector wire",
-                "requests", float(snap["requests"]))
-        _cdelta("pio_wire_responses_total",
-                "Responses fully written by the selector wire",
-                "responses", float(snap["responses"]))
-        _cdelta("pio_wire_send_failures_total",
-                "Response writes that failed or timed out",
-                "send_failures", float(snap["send_failures"]))
-        _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
-                "bytes_in", float(snap["bytes_in"]), dir="in")
-        _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
-                "bytes_out", float(snap["bytes_out"]), dir="out")
-        for status, count in dict(snap["errors"]).items():
-            _cdelta("pio_wire_errors_total",
-                    "Wire-level framing error responses by status",
-                    f"err{status}", float(count), status=str(status))
-        gauges = (
-            ("pio_wire_connections_open",
-             "Connections currently registered with the reactor",
-             float(snap["open_conns"])),
-            ("pio_wire_queue_depth",
-             "Connections waiting for a wire worker",
-             float(snap["queue_depth"])),
-            ("pio_wire_workers_busy",
-             "Wire workers currently running a handler",
-             float(snap["busy_workers"])),
-            ("pio_wire_workers", "Wire worker pool size",
-             float(snap["workers"])),
-            ("pio_wire_pipeline_depth_hwm",
-             "High-water mark of framed-but-unserved pipelined requests "
-             "on one connection", float(snap["pipeline_hwm"])),
-        )
-        for name, help_text, value in gauges:
-            m.gauge(name, help_text,
-                    labels=("listen",)).labels(listen=listen).set(value)
-        reqs = float(snap["requests"])
-        reuse = (reqs - float(snap["accepted"])) / reqs if reqs > 0 else 0.0
-        m.gauge("pio_wire_keepalive_reuse_ratio",
-                "Fraction of requests that reused a kept-alive "
-                "connection", labels=("listen",)).labels(
-                    listen=listen).set(max(0.0, reuse))
+        for rs in shards:
+            r = str(rs.get("reactor", 0))
+            _cdelta("pio_wire_connections_accepted_total",
+                    "Connections accepted by the selector wire",
+                    f"accepted[{r}]", float(rs["accepted"]), reactor=r)
+            _cdelta("pio_wire_requests_total",
+                    "Requests framed off the selector wire",
+                    f"requests[{r}]", float(rs["requests"]), reactor=r)
+            _cdelta("pio_wire_responses_total",
+                    "Responses fully written by the selector wire",
+                    f"responses[{r}]", float(rs["responses"]), reactor=r)
+            _cdelta("pio_wire_egress_flushes_total",
+                    "Gathered egress syscalls (sendmsg batches); "
+                    "responses/flushes is the writev coalescing ratio",
+                    f"flushes[{r}]", float(rs.get("flushes", 0)),
+                    reactor=r)
+            _cdelta("pio_wire_send_failures_total",
+                    "Response writes that failed or timed out",
+                    f"send_failures[{r}]", float(rs["send_failures"]),
+                    reactor=r)
+            _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
+                    f"bytes_in[{r}]", float(rs["bytes_in"]),
+                    dir="in", reactor=r)
+            _cdelta("pio_wire_bytes_total", "Wire bytes by direction",
+                    f"bytes_out[{r}]", float(rs["bytes_out"]),
+                    dir="out", reactor=r)
+            for status, count in dict(rs["errors"]).items():
+                _cdelta("pio_wire_errors_total",
+                        "Wire-level framing error responses by status",
+                        f"err{status}[{r}]", float(count),
+                        status=str(status), reactor=r)
+            gauges = (
+                ("pio_wire_connections_open",
+                 "Connections currently registered with the reactor",
+                 float(rs["open_conns"])),
+                ("pio_wire_queue_depth",
+                 "Connections waiting for a wire worker",
+                 float(rs["queue_depth"])),
+                ("pio_wire_workers_busy",
+                 "Wire workers currently running a handler",
+                 float(rs["busy_workers"])),
+                ("pio_wire_workers", "Wire worker pool size",
+                 float(rs["workers"])),
+                ("pio_wire_pipeline_depth_hwm",
+                 "High-water mark of framed-but-unserved pipelined "
+                 "requests on one connection",
+                 float(rs["pipeline_hwm"])),
+            )
+            for name, help_text, value in gauges:
+                m.gauge(name, help_text,
+                        labels=("listen", "reactor")).labels(
+                            listen=listen, reactor=r).set(value)
+            reqs = float(rs["requests"])
+            reuse = ((reqs - float(rs["accepted"])) / reqs
+                     if reqs > 0 else 0.0)
+            m.gauge("pio_wire_keepalive_reuse_ratio",
+                    "Fraction of requests that reused a kept-alive "
+                    "connection", labels=("listen", "reactor")).labels(
+                        listen=listen, reactor=r).set(max(0.0, reuse))
 
     # -- health/readiness ---------------------------------------------------
     def readiness(self) -> Tuple[bool, Dict[str, Any]]:
@@ -556,6 +577,14 @@ class HTTPServerBase:
 
         def _bind():
             if use_selector:
+                # PIO_WIRE_REACTORS > 1 shards the accept loop across
+                # N reactors (SO_REUSEPORT, or fd handoff where that is
+                # unavailable); at 1 the single-reactor wire is used
+                # unchanged.
+                n = reactor_count()
+                if n > 1:
+                    return ShardedWire((self.host, self.port),
+                                       self._handle_raw, reactors=n)
                 return SelectorWire((self.host, self.port),
                                     self._handle_raw)
             return _Server((self.host, self.port), _Handler)
@@ -578,6 +607,7 @@ class HTTPServerBase:
             self._httpd.socket = self._ssl_context.wrap_socket(
                 self._httpd.socket, server_side=True)
         self.port = self._httpd.server_address[1]
+        self._on_bound()
         if background:
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
@@ -585,6 +615,12 @@ class HTTPServerBase:
         else:
             self._httpd.serve_forever()
         return self.port
+
+    def _on_bound(self) -> None:
+        """Subclass hook: runs after the wire is bound (self._httpd
+        set, self.port final) and before serve_forever — the place to
+        connect wire-facing callbacks like the micro-batcher's
+        flush_hint cross-wakeup."""
 
     def shutdown(self) -> None:
         # idempotent + thread-safe: the /stop handler thread and a caller

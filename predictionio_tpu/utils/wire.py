@@ -6,9 +6,15 @@ the ceiling was thread-per-connection handoffs and per-request header
 dict construction in the stdlib `ThreadingHTTPServer` stack, not the
 accelerator. This module replaces that stack for the serve plane:
 
-  - ONE reactor thread multiplexes every persistent keep-alive
-    connection through a `selectors` readiness loop (accept + recv +
-    incremental framing only — never a handler);
+  - a reactor thread multiplexes persistent keep-alive connections
+    through a `selectors` readiness loop (accept + recv + incremental
+    framing only — never a handler); `ShardedWire` scales that to N
+    reactors (`PIO_WIRE_REACTORS`, default min(4, cpus)), each with its
+    own `SO_REUSEPORT` listener on the same port so the kernel shards
+    the accept stream, its own selector, connection table, idle sweep,
+    and slice of the worker pool. Where SO_REUSEPORT is unavailable,
+    reactor 0 keeps the single listener and hands accepted sockets to
+    its siblings round-robin (`SelectorWire.adopt`).
   - a small fixed worker pool runs handlers, so 10k idle keep-alive
     connections cost one selector registration each instead of one
     blocked thread each (the documented starvation failure of the
@@ -18,8 +24,20 @@ accelerator. This module replaces that stack for the serve plane:
     a route needs (`RawRequest.header`), with NO dict-of-headers built
     until a legacy route asks for one; the body is sliced out of the
     recv buffer exactly once;
-  - responses are assembled as a single bytes join from pre-encoded
-    status lines and written with one send loop.
+  - egress coalesces pipelined bursts: responses land on a
+    per-connection queue and are flushed with one gathered
+    `socket.sendmsg` (writev-style iovecs, no `b"".join` copies) —
+    while more pipelined requests are pending the flush is deferred so
+    a 64-deep burst leaves in one syscall, strictly in request order
+    (`PIO_WIRE_SENDMSG=0` restores one send per response). When the
+    micro-batcher completes a drain it calls `flush_hint()` and the
+    reactors opportunistically push any deferred responses without
+    waiting for the owning worker.
+  - a length-prefixed binary query framing for SDK clients
+    (`Content-Type: application/x-pio-bin`): `decode_bin_query` reads
+    a msgpack-subset map straight into the fast path's (user, num)
+    shape, skipping JSON entirely; responses reuse the same
+    pre-serialized splice as the JSON route.
 
 The wire knows nothing about routes, JSON, metrics, or tenancy: it
 calls one `handler(RawRequest) -> (response_bytes, close?)` supplied by
@@ -38,13 +56,16 @@ openings keep it that way without blinding the flight recorder:
   - `set_trace_hooks(stamp_new, on_sent)` installs two opaque
     callbacks (from `obs/trace.py`, via HTTPServerBase.start): one
     allocates preallocated stamp slots onto `RawRequest.trace` as a
-    request is framed, the other fires after the response bytes hit
-    the socket. Both are None by default and the hot path checks one
-    global before paying anything — tracing off costs two loads.
+    request is framed (the wire stamps `.reactor` onto whatever comes
+    back so traces attribute accept-shard skew), the other fires after
+    the response bytes hit the socket. Both are None by default and
+    the hot path checks one global before paying anything — tracing
+    off costs two loads.
   - `SelectorWire.stats` counts raw wire activity (accepts, framed
-    requests, bytes, pipeline high-water, busy workers) as plain ints;
-    the obs layer scrapes `stats_snapshot()` into `pio_wire_*`
-    families on /metrics. No metrics objects live here.
+    requests, bytes, pipeline high-water, gathered flushes, busy
+    workers) as plain ints; the obs layer scrapes `stats_snapshot()`
+    into `pio_wire_*` families on /metrics, one `reactor` label per
+    shard. No metrics objects live here.
 """
 
 from __future__ import annotations
@@ -72,6 +93,11 @@ KEEPALIVE_IDLE_S = float(os.environ.get("PIO_WIRE_IDLE_S", "65"))
 PIPELINE_MAX = 64
 _RECV_CHUNK = 1 << 18
 _SEND_TIMEOUT_S = 30.0
+# gathered-egress cap: a deferred pipelined burst is flushed once this
+# many responses are queued even if more requests are still pending
+_FLUSH_MAX_IOV = 64
+SENDMSG_ON = os.environ.get(
+    "PIO_WIRE_SENDMSG", "1").strip().lower() not in ("0", "off", "false")
 
 RawHandler = Callable[["RawRequest"], Tuple[bytes, bool]]
 
@@ -86,11 +112,58 @@ def set_trace_hooks(stamp_new: Optional[Callable[[float], object]],
                     ) -> None:
     """Install (or clear, with Nones) the flight-recorder hooks:
     `stamp_new(t_first_read) -> trace-or-None` runs as a request is
-    framed, `on_sent(raw)` after its response bytes are on the
-    socket."""
+    framed (a non-None result gets `.reactor` set to the framing
+    reactor's index), `on_sent(raw)` after its response bytes are on
+    the socket."""
     global _STAMP_NEW, _ON_SENT
     _STAMP_NEW = stamp_new
     _ON_SENT = on_sent
+
+
+def reactor_count() -> int:
+    """`PIO_WIRE_REACTORS`, default min(4, cpu count): reactors are
+    readiness loops, more of them than cores only adds contention."""
+    raw = os.environ.get("PIO_WIRE_REACTORS", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def _default_workers() -> int:
+    # Workers BLOCK in the handler (device step, store reads), they are
+    # not CPU-bound — size the pool to cover the admission layer's
+    # concurrency, not the core count, or overload queues invisibly at
+    # the wire instead of shedding 429/503 with Retry-After at the app
+    # layer.
+    return int(os.environ.get(
+        "PIO_WIRE_WORKERS",
+        str(max(16, min(64, 4 * (os.cpu_count() or 4))))))
+
+
+def _bind_listener(server_address: Tuple[str, int],
+                   reuse_port: bool = False) -> socket.socket:
+    """Bind + listen a nonblocking listener. With reuse_port=True the
+    SO_REUSEPORT option must exist and stick — any failure raises
+    OSError so ShardedWire can fall back to fd handoff."""
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port:
+            opt = getattr(socket, "SO_REUSEPORT", None)
+            if opt is None:
+                raise OSError("SO_REUSEPORT unavailable")
+            ls.setsockopt(socket.SOL_SOCKET, opt, 1)
+        ls.bind(server_address)
+    except OSError:
+        ls.close()
+        raise
+    ls.listen(1024)
+    ls.setblocking(False)
+    return ls
+
 
 _REASONS = http.client.responses
 _STATUS_LINES: Dict[int, bytes] = {
@@ -252,9 +325,133 @@ def frame_request(buf: bytearray, client: str = ""
     return raw, total
 
 
+# -- binary query framing ----------------------------------------------------
+# The SDK fast lane: `Content-Type: application/x-pio-bin` carries the
+# dominant serve query {"user": <str>, "num": <int>} as a msgpack-subset
+# map, decoded by direct byte indexing straight into the same (user,
+# num) pair the JSON fast-path regex produces. Strict by construction:
+# exactly two fixstr keys in fixed order, nothing trailing, so the
+# binary route accepts a SUBSET of what the JSON route serves
+# (fuzz-gated accept containment in tests/test_wire.py). Responses are
+# spliced from the same pre-serialized JSON fragments — only the
+# request side changes representation.
+
+BIN_CONTENT_TYPE = "application/x-pio-bin"
+_BIN_PREFIX = b"\x82\xa4user"   # fixmap(2) + fixstr(4) "user"
+_BIN_NUM_KEY = b"\xa3num"       # fixstr(3) "num"
+_BIN_NUM_MAX = 999_999_999      # parity with the JSON fast-path regex
+
+
+def encode_bin_query(user: str, num: int) -> bytes:
+    """Encode the dominant serve query as the msgpack-subset frame
+    `decode_bin_query` accepts (client/SDK side; the server only ever
+    decodes). fixstr/str8/str16 user id, fixint/uint16/int32 num."""
+    if num > _BIN_NUM_MAX or num < -_BIN_NUM_MAX:
+        raise ValueError("num out of range for the binary query frame")
+    ub = user.encode("utf-8")
+    ul = len(ub)
+    if ul <= 31:
+        uhead = bytes((0xa0 | ul,))
+    elif ul <= 0xff:
+        uhead = b"\xd9" + bytes((ul,))
+    elif ul <= 0xffff:
+        uhead = b"\xda" + ul.to_bytes(2, "big")
+    else:
+        raise ValueError("user id too long for the binary query frame")
+    if 0 <= num <= 0x7f:
+        nb = bytes((num,))
+    elif -32 <= num < 0:
+        nb = bytes((num & 0xff,))
+    elif 0 <= num <= 0xffff:
+        nb = b"\xcd" + num.to_bytes(2, "big")
+    else:
+        nb = b"\xd2" + num.to_bytes(4, "big", signed=True)
+    return b"".join((_BIN_PREFIX, uhead, ub, _BIN_NUM_KEY, nb))
+
+
+def decode_bin_query(body: bytes) -> Optional[Tuple[str, int]]:
+    """Decode one binary query frame to (user, num), or None when the
+    body is not the exact shape `encode_bin_query` emits. Rejects
+    trailing bytes, out-of-range nums, and invalid UTF-8 so every
+    accepted frame maps onto a query the JSON route would also serve.
+
+    The dominant shape (fixstr user <= 31 bytes, one-byte num) is
+    decoded inline with the minimum of branches — it is ~95% of SDK
+    traffic and the whole point of the frame; everything else takes
+    `_decode_bin_slow`."""
+    lb = len(body)
+    if lb < 12 or body[:6] != _BIN_PREFIX:
+        return None
+    c = body[6]
+    if 0xa0 <= c <= 0xbf:
+        e = 7 + (c & 0x1f)
+        p = e + 4
+        if lb == p + 1 and body[e:p] == _BIN_NUM_KEY:
+            c2 = body[p]
+            if c2 <= 0x7f:
+                try:
+                    return body[7:e].decode("utf-8"), c2
+                except UnicodeDecodeError:
+                    return None
+            if c2 >= 0xe0:
+                try:
+                    return body[7:e].decode("utf-8"), c2 - 256
+                except UnicodeDecodeError:
+                    return None
+            return None      # one trailing byte that is no fixint
+    return _decode_bin_slow(body, lb, c)
+
+
+def _decode_bin_slow(body: bytes, lb: int, c: int
+                     ) -> Optional[Tuple[str, int]]:
+    # the off-dominant encodings: str8/str16 user ids, uint16/int32
+    # nums, and every reject path the fast lane skipped
+    if 0xa0 <= c <= 0xbf:
+        s = 7
+        e = s + (c & 0x1f)
+    elif c == 0xd9:
+        s = 8
+        e = s + body[7]
+    elif c == 0xda:
+        s = 9
+        e = s + ((body[7] << 8) | body[8])
+    else:
+        return None
+    p = e + 4
+    if lb <= p or body[e:p] != _BIN_NUM_KEY:
+        return None
+    c2 = body[p]
+    if c2 <= 0x7f:
+        num = c2
+        q = p + 1
+    elif c2 >= 0xe0:
+        num = c2 - 256
+        q = p + 1
+    elif c2 == 0xcd:
+        q = p + 3
+        if lb < q:
+            return None
+        num = (body[p + 1] << 8) | body[p + 2]
+    elif c2 == 0xd2:
+        q = p + 5
+        if lb < q:
+            return None
+        num = int.from_bytes(body[p + 1:q], "big", signed=True)
+    else:
+        return None
+    if q != lb or num > _BIN_NUM_MAX or num < -_BIN_NUM_MAX:
+        return None
+    try:
+        user = body[s:e].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    return user, num
+
+
 class _Conn:
     __slots__ = ("sock", "fd", "client", "buf", "pending", "busy",
-                 "closing", "last_active", "lock", "t_read")
+                 "closing", "last_active", "lock", "t_read", "outq",
+                 "wlock")
 
     def __init__(self, sock: socket.socket, client: str):
         self.sock = sock
@@ -268,17 +465,23 @@ class _Conn:
         self.last_active = time.monotonic()
         self.lock = threading.Lock()
         self.t_read = 0.0          # first-read stamp for the next request
+        # egress: (response bytes-or-memoryview, RawRequest-or-None),
+        # appended under `lock`, drained under `wlock` (egress order)
+        self.outq: Deque[tuple] = deque()
+        self.wlock = threading.Lock()
 
 
 class WireStats:
     """Raw wire activity counters: plain ints, no metrics objects, so
     the wire stays obs-free. Reactor-owned fields (accepted, requests,
     bytes_in, pipeline_hwm, errors) are written by the reactor thread
-    only; `lock` guards the worker-side fields."""
+    only; `lock` guards the worker-side fields. `flushes` counts
+    gathered egress syscalls — responses/flushes is the writev
+    coalescing ratio the bench gates on."""
 
     __slots__ = ("accepted", "requests", "bytes_in", "pipeline_hwm",
                  "errors", "lock", "bytes_out", "responses",
-                 "send_failures", "busy_workers")
+                 "send_failures", "busy_workers", "flushes")
 
     def __init__(self):
         self.accepted = 0
@@ -291,49 +494,53 @@ class WireStats:
         self.responses = 0
         self.send_failures = 0
         self.busy_workers = 0
+        self.flushes = 0
 
 
 class SelectorWire:
-    """The selector front end. API mirrors ThreadingHTTPServer just
+    """One selector reactor. API mirrors ThreadingHTTPServer just
     enough (`server_address`, `serve_forever`, `shutdown`,
-    `server_close`) that HTTPServerBase treats both wires uniformly."""
+    `server_close`) that HTTPServerBase treats both wires uniformly.
 
-    def __init__(self, server_address: Tuple[str, int],
-                 handler: RawHandler, workers: int = 0):
+    Sharding hooks (used by ShardedWire, inert standalone): `index`
+    names the reactor in stats/traces; `listener` adopts a pre-bound
+    socket (SO_REUSEPORT shard) instead of binding here; a reactor
+    built with neither address nor listener accepts nothing and is fed
+    via `adopt()` (the fd-handoff fallback)."""
+
+    def __init__(self, server_address: Optional[Tuple[str, int]],
+                 handler: RawHandler, workers: int = 0, *,
+                 index: int = 0,
+                 listener: Optional[socket.socket] = None,
+                 sendmsg: Optional[bool] = None):
         self._handler = handler
         self._stop = False
         self._done = threading.Event()
         self._lifecycle = threading.Lock()
         self._conns: Dict[int, _Conn] = {}
         self._to_close: Deque[_Conn] = deque()
+        self._adoptq: Deque[Tuple[socket.socket, str]] = deque()
+        self._flush_req = False
+        self._dispatch: Optional[Callable[[socket.socket, str], bool]] \
+            = None
+        self.index = index
+        self._sendmsg_on = SENDMSG_ON if sendmsg is None else bool(sendmsg)
         self.stats = WireStats()
         if workers <= 0:
-            # Workers BLOCK in the handler (device step, store reads),
-            # they are not CPU-bound — size the pool to cover the
-            # admission layer's concurrency, not the core count, or
-            # overload queues invisibly at the wire instead of shedding
-            # 429/503 with Retry-After at the app layer.
-            workers = int(os.environ.get(
-                "PIO_WIRE_WORKERS",
-                str(max(16, min(64, 4 * (os.cpu_count() or 4))))))
+            workers = _default_workers()
         self._n_workers = max(1, workers)
         import queue as _queue
         self._workq: "_queue.Queue" = _queue.Queue()
         self._workers: List[threading.Thread] = []
         # bind in the constructor so the caller's EADDRINUSE retry loop
         # wraps construction, exactly as with ThreadingHTTPServer
-        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        try:
-            ls.bind(server_address)
-        except OSError:
-            ls.close()
-            raise
-        ls.listen(1024)
-        ls.setblocking(False)
-        self._listener = ls
-        self.server_address = ls.getsockname()
-        # wake pipe: shutdown() and worker close-requests nudge select()
+        if listener is None and server_address is not None:
+            listener = _bind_listener(server_address)
+        self._listener = listener
+        self.server_address = (listener.getsockname()
+                               if listener is not None else ("", 0))
+        # wake pipe: shutdown(), adopt() and worker close-requests
+        # nudge select()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._sel = selectors.DefaultSelector()
@@ -342,26 +549,33 @@ class SelectorWire:
     def serve_forever(self) -> None:
         for i in range(self._n_workers):
             t = threading.Thread(target=self._worker_loop, daemon=True,
-                                 name=f"wire-worker-{i}")
+                                 name=f"wire-{self.index}-worker-{i}")
             t.start()
             self._workers.append(t)
         sel = self._sel
-        sel.register(self._listener, selectors.EVENT_READ, "accept")
+        if self._listener is not None:
+            sel.register(self._listener, selectors.EVENT_READ, "accept")
         sel.register(self._wake_r, selectors.EVENT_READ, "wake")
         last_sweep = time.monotonic()
         try:
             while not self._stop:
                 for key, _ in sel.select(1.0):
-                    if key.data == "accept":
+                    data = key.data
+                    if data == "accept":
                         self._accept()
-                    elif key.data == "wake":
+                    elif data == "wake":
                         try:
                             while self._wake_r.recv(4096):
                                 pass
                         except (BlockingIOError, OSError):
                             pass
                     else:
-                        self._on_readable(key.data)
+                        self._on_readable(data)
+                if self._adoptq:
+                    self._drain_adopted()
+                if self._flush_req:
+                    self._flush_req = False
+                    self._flush_pass()
                 self._drain_close_requests()
                 now = time.monotonic()
                 if now - last_sweep >= 5.0:
@@ -383,10 +597,29 @@ class SelectorWire:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            conn = _Conn(sock, addr[0] if addr else "")
-            self._conns[conn.fd] = conn
-            self.stats.accepted += 1
-            self._sel.register(sock, selectors.EVENT_READ, conn)
+            client = addr[0] if addr else ""
+            d = self._dispatch
+            if d is not None and d(sock, client):
+                continue               # handed to a sibling reactor
+            self._register_conn(sock, client)
+
+    def adopt(self, sock: socket.socket, client: str) -> None:
+        """Hand an already-accepted socket to this reactor — the
+        round-robin fallback path when SO_REUSEPORT cannot shard the
+        accept stream at the kernel."""
+        self._adoptq.append((sock, client))
+        self._wake()
+
+    def _drain_adopted(self) -> None:
+        while self._adoptq:
+            sock, client = self._adoptq.popleft()
+            self._register_conn(sock, client)
+
+    def _register_conn(self, sock: socket.socket, client: str) -> None:
+        conn = _Conn(sock, client)
+        self._conns[conn.fd] = conn
+        self.stats.accepted += 1
+        self._sel.register(sock, selectors.EVENT_READ, conn)
 
     def _on_readable(self, conn: _Conn) -> None:
         eof = False
@@ -442,6 +675,8 @@ class SelectorWire:
             sn = _STAMP_NEW
             if sn is not None:
                 raw.trace = sn(conn.t_read)
+                if raw.trace is not None:
+                    raw.trace.reactor = self.index
             st.requests += 1
             with conn.lock:
                 conn.pending.append(("req", raw))
@@ -459,7 +694,7 @@ class SelectorWire:
         for conn in list(self._conns.values()):
             with conn.lock:
                 idle = (not conn.busy and not conn.pending
-                        and not conn.buf
+                        and not conn.buf and not conn.outq
                         and now - conn.last_active > KEEPALIVE_IDLE_S)
             if idle:
                 self._unregister(conn)
@@ -490,6 +725,18 @@ class SelectorWire:
         except OSError:
             pass
 
+    def flush_hint(self) -> None:
+        """Cross-wakeup from the micro-batcher: a batch just drained,
+        so deferred pipelined responses are likely complete — nudge the
+        reactor to push them without waiting for the owning worker."""
+        self._flush_req = True
+        self._wake()
+
+    def _flush_pass(self) -> None:
+        for conn in list(self._conns.values()):
+            if conn.outq:
+                self._flush_out(conn, wait=False)
+
     # -- workers -------------------------------------------------------------
     def _worker_loop(self) -> None:
         st = self.stats
@@ -508,7 +755,9 @@ class SelectorWire:
     def _service(self, conn: _Conn) -> None:
         """Serve this connection's framed requests in order; the busy
         flag guarantees one worker per connection, so pipelined
-        responses cannot interleave."""
+        responses cannot interleave. Responses land on conn.outq; the
+        flush is deferred while more pipelined requests are pending so
+        a whole burst leaves in one gathered sendmsg."""
         while True:
             with conn.lock:
                 if not conn.pending:
@@ -517,7 +766,9 @@ class SelectorWire:
                     break
                 kind, item = conn.pending.popleft()
             if kind == "err":
-                self._send(conn, item)
+                with conn.lock:
+                    conn.outq.append((item, None))
+                self._flush_out(conn)
                 self._request_close(conn)
                 return
             try:
@@ -527,51 +778,106 @@ class SelectorWire:
                     500, "application/json",
                     b'{"message": "internal wire error"}',
                     keep_alive=False), True
-            sent = self._send(conn, data)
-            cb = _ON_SENT
-            if sent and cb is not None and item.trace is not None:
-                try:
-                    cb(item)
-                except Exception:
-                    pass               # tracing must never kill a worker
-            if not sent or close or not item.keep_alive:
+            with conn.lock:
+                conn.outq.append((data, item))
+                defer = (self._sendmsg_on and bool(conn.pending)
+                         and len(conn.outq) < _FLUSH_MAX_IOV
+                         and not close and item.keep_alive)
+            if not defer and not self._flush_out(conn):
+                self._request_close(conn)
+                return
+            if close or not item.keep_alive:
                 self._request_close(conn)
                 return
             conn.last_active = time.monotonic()
         if close_now:
+            self._flush_out(conn)
             self._request_close(conn)
 
-    def _send(self, conn: _Conn, data: bytes) -> bool:
-        """Blocking-with-timeout send on the nonblocking socket; small
-        responses nearly always complete in one call."""
-        mv = memoryview(data)
-        end = time.monotonic() + _SEND_TIMEOUT_S
-        sock = conn.sock
+    def _flush_out(self, conn: _Conn, wait: bool = True) -> bool:
+        """Drain conn.outq to the socket: one gathered `sendmsg` per
+        queued batch (writev — no join copies), one plain send per
+        response when PIO_WIRE_SENDMSG is off. wait=False is the
+        reactor's opportunistic path: it never blocks, requeueing any
+        unsent tail in order for the owning worker."""
+        if wait:
+            conn.wlock.acquire()
+        elif not conn.wlock.acquire(blocking=False):
+            return True                # a worker owns egress right now
+        try:
+            return self._flush_locked(conn, wait)
+        finally:
+            conn.wlock.release()
+
+    def _flush_locked(self, conn: _Conn, wait: bool) -> bool:
         st = self.stats
-        while mv:
-            try:
-                n = sock.send(mv)
-                mv = mv[n:]
-            except (BlockingIOError, InterruptedError):
-                remaining = end - time.monotonic()
-                if remaining <= 0:
-                    with st.lock:
-                        st.send_failures += 1
-                    return False
+        sock = conn.sock
+        end = time.monotonic() + _SEND_TIMEOUT_S
+        while True:
+            with conn.lock:
+                if not conn.outq:
+                    return True
+                if self._sendmsg_on:
+                    items = list(conn.outq)
+                    conn.outq.clear()
+                else:
+                    items = [conn.outq.popleft()]
+            bufs = [memoryview(d) for d, _ in items]
+            idx = 0
+            while bufs:
                 try:
-                    select.select([], [sock], [], min(remaining, 1.0))
-                except (OSError, ValueError):
-                    with st.lock:
-                        st.send_failures += 1
-                    return False
-            except OSError:
+                    if self._sendmsg_on:
+                        n = sock.sendmsg(bufs)
+                    else:
+                        n = sock.send(bufs[0])
+                except (BlockingIOError, InterruptedError):
+                    if not wait:
+                        # requeue the unsent tail at the head, in order
+                        with conn.lock:
+                            conn.outq.extendleft(
+                                (bufs[j], items[idx + j][1])
+                                for j in range(len(bufs) - 1, -1, -1))
+                        return True
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        return self._flush_fail()
+                    try:
+                        select.select([], [sock], [],
+                                      min(remaining, 1.0))
+                    except (OSError, ValueError):
+                        return self._flush_fail()
+                    continue
+                except OSError:
+                    return self._flush_fail()
                 with st.lock:
-                    st.send_failures += 1
-                return False
-        with st.lock:
-            st.bytes_out += len(data)
-            st.responses += 1
-        return True
+                    st.flushes += 1
+                    st.bytes_out += n
+                while n:
+                    head = bufs[0]
+                    if n >= len(head):
+                        n -= len(head)
+                        bufs.pop(0)
+                        self._mark_sent(items[idx])
+                        idx += 1
+                    else:
+                        bufs[0] = head[n:]
+                        break
+
+    def _flush_fail(self) -> bool:
+        with self.stats.lock:
+            self.stats.send_failures += 1
+        return False
+
+    def _mark_sent(self, item: tuple) -> None:
+        raw = item[1]
+        with self.stats.lock:
+            self.stats.responses += 1
+        cb = _ON_SENT
+        if cb is not None and raw is not None and raw.trace is not None:
+            try:
+                cb(raw)
+            except Exception:
+                pass               # tracing must never kill a worker
 
     def _request_close(self, conn: _Conn) -> None:
         """Workers never touch the selector: shut the socket down and
@@ -596,7 +902,9 @@ class SelectorWire:
                 "responses": st.responses,
                 "send_failures": st.send_failures,
                 "busy_workers": st.busy_workers,
+                "flushes": st.flushes,
             }
+        out["reactor"] = self.index
         out["accepted"] = st.accepted
         out["requests"] = st.requests
         out["bytes_in"] = st.bytes_in
@@ -620,10 +928,17 @@ class SelectorWire:
             self._workq.put(None)
         for t in workers:
             t.join(timeout=2.0)
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        while self._adoptq:
+            sock, _ = self._adoptq.popleft()
+            try:
+                sock.close()
+            except OSError:
+                pass
         for conn in list(self._conns.values()):
             self._unregister(conn)
             self._destroy(conn)
@@ -638,6 +953,119 @@ class SelectorWire:
                 pass
 
 
+class ShardedWire:
+    """N SelectorWire reactors behind one serve port.
+
+    With SO_REUSEPORT every reactor owns its own listener bound to the
+    same (host, port) and the KERNEL shards the accept stream — no
+    user-space handoff, no shared accept lock. Where SO_REUSEPORT is
+    unavailable (or refused at bind), reactor 0 keeps the only
+    listener and deals accepted sockets to its siblings round-robin
+    via `SelectorWire.adopt`. Each reactor runs its own selector,
+    connection table, idle sweep, and worker-pool slice; lifecycle and
+    stats mirror SelectorWire so HTTPServerBase treats every wire the
+    same. `stats_snapshot()` returns the aggregate plus a
+    `"reactors"` list of per-shard snapshots."""
+
+    def __init__(self, server_address: Tuple[str, int],
+                 handler: RawHandler, reactors: int = 0,
+                 workers: int = 0):
+        n = max(1, reactors if reactors > 0 else reactor_count())
+        if workers <= 0:
+            workers = _default_workers()
+        per = max(1, -(-workers // n))     # ceil-divided pool slice
+        listeners: List[Optional[socket.socket]] = []
+        self.reuse_port = False
+        if n > 1:
+            try:
+                first = _bind_listener(server_address, reuse_port=True)
+                listeners.append(first)
+                host = server_address[0]
+                port = first.getsockname()[1]
+                for _ in range(n - 1):
+                    listeners.append(
+                        _bind_listener((host, port), reuse_port=True))
+                self.reuse_port = True
+            except OSError:
+                for ls in listeners:
+                    if ls is not None:
+                        try:
+                            ls.close()
+                        except OSError:
+                            pass
+                listeners = []
+        if not listeners:
+            listeners = [_bind_listener(server_address)]
+            listeners.extend([None] * (n - 1))
+        self.reactors: List[SelectorWire] = [
+            SelectorWire(None, handler, workers=per, index=i,
+                         listener=listeners[i])
+            for i in range(n)
+        ]
+        self.server_address = self.reactors[0].server_address
+        for r in self.reactors[1:]:
+            if r._listener is None:
+                r.server_address = self.server_address
+        self._rr = 0
+        if not self.reuse_port and n > 1:
+            self.reactors[0]._dispatch = self._dispatch_round_robin
+        self._threads: List[threading.Thread] = []
+
+    def _dispatch_round_robin(self, sock: socket.socket,
+                              client: str) -> bool:
+        i = self._rr = (self._rr + 1) % len(self.reactors)
+        if i == 0:
+            return False               # reactor 0 keeps its share
+        self.reactors[i].adopt(sock, client)
+        return True
+
+    def serve_forever(self) -> None:
+        for r in self.reactors[1:]:
+            t = threading.Thread(target=r.serve_forever, daemon=True,
+                                 name=f"wire-reactor-{r.index}")
+            t.start()
+            self._threads.append(t)
+        self.reactors[0].serve_forever()
+
+    def flush_hint(self) -> None:
+        for r in self.reactors:
+            r.flush_hint()
+
+    def stats_snapshot(self) -> Dict[str, object]:
+        """Aggregate counters plus per-reactor snapshots under
+        "reactors" — the obs layer emits one `reactor` label per
+        entry, the dashboard renders accept-shard balance from it."""
+        per = [r.stats_snapshot() for r in self.reactors]
+        agg: Dict[str, object] = {
+            "reactor": -1,
+            "reuse_port": self.reuse_port,
+            "reactors": per,
+        }
+        for k in ("accepted", "requests", "bytes_in", "bytes_out",
+                  "responses", "flushes", "send_failures",
+                  "busy_workers", "open_conns", "queue_depth",
+                  "workers"):
+            agg[k] = sum(s[k] for s in per)
+        agg["pipeline_hwm"] = max(s["pipeline_hwm"] for s in per)
+        errors: Dict[int, int] = {}
+        for s in per:
+            for code, cnt in s["errors"].items():
+                errors[code] = errors.get(code, 0) + cnt
+        agg["errors"] = errors
+        return agg
+
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self) -> None:
+        for r in self.reactors:
+            r.shutdown()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def server_close(self) -> None:
+        for r in self.reactors:
+            r.server_close()
+
+
 class HTTPConnectionPool:
     """Persistent upstream connections for the fleet proxy.
 
@@ -647,7 +1075,11 @@ class HTTPConnectionPool:
     (host, port), retries exactly once on a stale reuse (the upstream
     closed its keep-alive between our requests), and returns transport
     failures as OSError so the caller's retry-next-replica loop and
-    ejection bookkeeping stay unchanged."""
+    ejection bookkeeping stay unchanged.
+
+    Bodies are opaque bytes and Content-Type is forwarded verbatim, so
+    binary-framed queries (`application/x-pio-bin`) proxy upstream
+    unchanged — the router never re-encodes."""
 
     def __init__(self, max_idle_per_host: int = 4):
         self.max_idle = max_idle_per_host

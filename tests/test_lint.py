@@ -431,6 +431,42 @@ def test_hot_route_trace_gate_scoped_to_wire_files(tmp_path):
     assert not lint.run(tmp_path)
 
 
+def test_hot_route_gate_covers_egress_functions(tmp_path):
+    # PR 13 extends the hot set to the gathered-egress/batch-flush path
+    bad = tmp_path / "predictionio_tpu" / "utils" / "wire.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import json\n"
+        "def _flush_locked(conn, wait):\n"
+        "    meta = {'fd': conn.fd}\n"
+        "def _flush_pass(self):\n"
+        "    tag = f'reactor-{self.index}'\n"
+        "def _mark_sent(self, item):\n"
+        "    return json.dumps(item)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "dict literal in hot-route '_flush_locked'" in kinds
+    assert "f-string in hot-route '_flush_pass'" in kinds
+    assert "json.dumps() in hot-route '_mark_sent'" in kinds
+
+
+def test_hot_route_gate_covers_binary_codec(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "utils" / "wire.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import json\n"
+        "def decode_bin_query(body):\n"
+        "    return json.loads(body)\n"       # the point is NOT to
+        "def encode_bin_query(user, num):\n"
+        "    return {'user': user, 'num': num}\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "json.loads() in hot-route 'decode_bin_query'" in kinds
+    assert "dict literal in hot-route 'encode_bin_query'" in kinds
+
+
 def test_tenant_growth_gate_catches_unbounded_maps(tmp_path):
     bad = tmp_path / "predictionio_tpu" / "tenancy" / "leaky.py"
     bad.parent.mkdir(parents=True)

@@ -24,6 +24,7 @@ from predictionio_tpu.serving import (
     EngineServerPlugin, OUTPUT_BLOCKER, PredictionServer, ServerConfig,
 )
 from predictionio_tpu.serving.server import to_jsonable
+from predictionio_tpu.utils.wire import BIN_CONTENT_TYPE, encode_bin_query
 
 
 def call(port, method, path, body=None):
@@ -127,6 +128,84 @@ class TestServe:
         with pytest.raises(RuntimeError, match="train"):
             PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
                              registry=mem_registry, engine=rec.engine())
+
+
+def call_raw(port, path, data, content_type):
+    """POST opaque bytes (the binary query frame) and return the raw
+    response body — `call` always speaks JSON."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST")
+    req.add_header("Content-Type", content_type)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestBinaryQueries:
+    def test_binary_query_parity_with_json(self, trained):
+        """The application/x-pio-bin frame must serve byte-identical
+        readings to the JSON route for the same logical query — the
+        response side is the same pre-serialized splice. The fast lane
+        needs the micro-batcher (batch_window_ms > 0) — without it the
+        generic JSON route is the only parser."""
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, batch_window_ms=2)
+        try:
+            if srv.wire != "selector":
+                pytest.skip("binary framing rides the selector wire")
+            for user, num in [("u1", 3), ("ghost", 2), ("u7", 1)]:
+                status, json_body = call(srv.port, "POST",
+                                         "/queries.json",
+                                         {"user": user, "num": num})
+                assert status == 200
+                status, raw = call_raw(srv.port, "/queries.json",
+                                       encode_bin_query(user, num),
+                                       BIN_CONTENT_TYPE)
+                assert status == 200
+                assert json.loads(raw) == json_body
+        finally:
+            srv.shutdown()
+
+    def test_malformed_binary_frame_400(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, batch_window_ms=2)
+        try:
+            if srv.wire != "selector":
+                pytest.skip("binary framing rides the selector wire")
+            status, raw = call_raw(srv.port, "/queries.json",
+                                   b"\x82junk-not-a-frame",
+                                   BIN_CONTENT_TYPE)
+            assert status == 400
+            assert b"binary" in raw
+        finally:
+            srv.shutdown()
+
+
+class TestShardedServe:
+    def test_reactors_env_serves_and_labels_metrics(self, trained,
+                                                    monkeypatch):
+        """PIO_WIRE_REACTORS=2 puts ShardedWire behind the server: the
+        serve chain works unchanged and /metrics carries one series per
+        accept shard via the reactor label."""
+        monkeypatch.setenv("PIO_WIRE_REACTORS", "2")
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine)
+        try:
+            if srv.wire != "selector":
+                pytest.skip("sharding applies to the selector wire")
+            for i in range(6):
+                status, body = call(srv.port, "POST", "/queries.json",
+                                    {"user": f"u{i}", "num": 2})
+                assert status == 200
+            status, text = call(srv.port, "GET", "/metrics")
+            assert status == 200
+            assert 'reactor="0"' in text
+            assert 'reactor="1"' in text
+            assert "pio_wire_egress_flushes_total" in text
+        finally:
+            srv.shutdown()
 
 
 class TestReloadStop:

@@ -792,3 +792,44 @@ class TestFleetTenancy:
                        app="aggro") > shed0
         # and its admitted trickle (within quota) still served fine
         assert set(aggro_out) <= {200, 429}, aggro_out
+
+    def test_standby_redirect_charges_quota(self, trained):
+        """Regression for the ROADMAP-flagged bypass concern: a standby
+        router's 307 redirect must spend the rate token BEFORE the
+        routing decision, so a client cannot farm free redirects during
+        a leader-handoff window; once the bucket is dry the standby
+        sheds 429 — and both answers carry a Location hint at the
+        leader so retries land on the node that will serve them."""
+        registry, engine, _, _ = trained
+        leader = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0),
+            FleetConfig(replicas=0, lease_ttl_s=5.0),
+            registry=registry, engine=engine)
+        leader.start()
+        standby = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0,
+                         tenancy=TenancyConfig(enabled=True, rate=0.01,
+                                               burst=2.0)),
+            FleetConfig(replicas=0, standby=True, lease_ttl_s=5.0),
+            registry=registry, engine=engine)
+        standby.start()
+        try:
+            assert leader.is_leader() and not standby.is_leader()
+            statuses, hdrs_by_status = [], {}
+            for _ in range(6):
+                status, _, hdrs = call(
+                    standby.port, "POST",
+                    f"/queries.json?accessKey={VICTIM_KEY}",
+                    {"user": "u1", "num": 2})
+                statuses.append(status)
+                hdrs_by_status[status] = hdrs
+            # burst=2, refill 0.01/s: exactly two redirects spend the
+            # bucket, everything after sheds
+            assert statuses[:2] == [307, 307], statuses
+            assert statuses[2:] == [429] * 4, statuses
+            assert str(leader.port) in hdrs_by_status[307]["Location"]
+            assert str(leader.port) in hdrs_by_status[429]["Location"]
+            assert int(hdrs_by_status[429]["Retry-After"]) >= 1
+        finally:
+            standby.stop()
+            leader.stop()

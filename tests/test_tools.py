@@ -57,6 +57,36 @@ class TestDashboard:
             srv.shutdown()
 
 
+class TestReactorBalance:
+    @staticmethod
+    def _series(value, reactor):
+        return {"labels": {"listen": "127.0.0.1:1", "reactor": reactor},
+                "value": value}
+
+    def test_renders_per_reactor_share(self):
+        from predictionio_tpu.tools.dashboard import _reactor_balance
+        snap = {
+            "pio_wire_requests_total": {"series": [
+                self._series(30.0, "0"), self._series(10.0, "1")]},
+            "pio_wire_connections_accepted_total": {"series": [
+                self._series(3.0, "0"), self._series(1.0, "1")]},
+            "pio_wire_connections_open": {"series": [
+                self._series(2.0, "0")]},
+        }
+        out = _reactor_balance(snap)
+        assert "Reactor balance" in out
+        assert "75.0%" in out and "25.0%" in out
+        # reactor rows come out in shard order
+        assert out.index("<td>0</td>") < out.index("<td>1</td>")
+
+    def test_single_reactor_renders_nothing(self):
+        from predictionio_tpu.tools.dashboard import _reactor_balance
+        snap = {"pio_wire_requests_total": {"series": [
+            self._series(5.0, "0")]}}
+        assert _reactor_balance(snap) == ""
+        assert _reactor_balance({}) == ""
+
+
 class TestAdmin:
     def test_app_crud_over_rest(self, mem_registry):
         srv = AdminServer(AdminConfig(ip="127.0.0.1", port=0), mem_registry)

@@ -15,21 +15,37 @@ Plus the fast-path parity fuzz: `_FAST_QUERY_RE` (the compiled
 /queries.json shape in `serving/server.py`) must never accept a body
 `json.loads` rejects, and must read the same (user, num) out of every
 body both can parse.
+
+PR 13 layers on top of both:
+
+  - gathered egress: a pipelined burst leaves in strictly fewer
+    `sendmsg` flushes than responses, still in request order, and the
+    micro-batcher's `flush_hint()` cross-wakeup pushes a deferred
+    response without waiting for the blocked owning worker;
+  - `ShardedWire`: N reactors behind one port over SO_REUSEPORT, the
+    round-robin fd-handoff fallback when that is unavailable, and a
+    shutdown that drains every reactor with no connection stranded;
+  - the binary query codec: round-trip, strict rejects, and the fuzzed
+    accept-containment gate — every frame `decode_bin_query` accepts
+    must map onto a (user, num) the JSON route reads identically.
 """
 
 import json
 import random
+import select
 import socket
 import string
 import threading
 import time
+import types
 
 import pytest
 
 from predictionio_tpu.serving.server import _FAST_QUERY_RE
 from predictionio_tpu.utils.wire import (
-    MAX_BODY_BYTES, MAX_HEADER_BYTES, RawRequest, SelectorWire, WireError,
-    build_response, frame_request,
+    MAX_BODY_BYTES, MAX_HEADER_BYTES, RawRequest, SelectorWire, ShardedWire,
+    WireError, build_response, decode_bin_query, encode_bin_query,
+    frame_request, set_trace_hooks,
 )
 
 pytestmark = pytest.mark.wire
@@ -422,3 +438,285 @@ class TestFastPathParity:
                 # accepts with the identical reading
                 assert _parse_generic(body) == fast, body
         assert checked_fast > 500     # the fuzz actually hit the shape
+
+
+# -- gathered egress (sendmsg coalescing + cross-wakeup) ----------------------
+
+def _run_wire(**kw):
+    srv = SelectorWire(("127.0.0.1", 0), _echo, **kw)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+def _stop_wire(srv, t):
+    srv.shutdown()
+    srv.server_close()
+    t.join(timeout=5)
+
+
+class TestGatheredEgress:
+    def test_pipelined_burst_coalesces_in_order(self):
+        srv, t = _run_wire(workers=2, sendmsg=True)
+        try:
+            n = 16
+            with _connect(srv) as s, s.makefile("rb") as f:
+                s.sendall(b"".join(_req(body=b"b%d" % i)
+                                   for i in range(n)))
+                for i in range(n):
+                    status, body, _ = _read_response(f)
+                    assert status == 200
+                    assert body == b"POST /echo b%d" % i
+            snap = srv.stats_snapshot()
+            assert snap["responses"] == n
+            # the burst left in gathered flushes, not one send each
+            assert 0 < snap["flushes"] < n
+        finally:
+            _stop_wire(srv, t)
+
+    def test_sendmsg_off_sends_per_response(self):
+        srv, t = _run_wire(workers=2, sendmsg=False)
+        try:
+            n = 8
+            with _connect(srv) as s, s.makefile("rb") as f:
+                s.sendall(b"".join(_req(body=b"p%d" % i)
+                                   for i in range(n)))
+                for i in range(n):
+                    status, body, _ = _read_response(f)
+                    assert status == 200
+                    assert body == b"POST /echo p%d" % i
+            snap = srv.stats_snapshot()
+            assert snap["responses"] == n
+            assert snap["flushes"] >= n       # one syscall per response
+        finally:
+            _stop_wire(srv, t)
+
+    def test_flush_hint_releases_deferred_response(self):
+        """With the worker blocked in /slow (0.5 s), the already-served
+        first response sits deferred on the egress queue; flush_hint()
+        makes the reactor push it long before the handler returns."""
+        srv, t = _run_wire(workers=1, sendmsg=True)
+        try:
+            with _connect(srv) as s, s.makefile("rb") as f:
+                s.sendall(_req(body=b"first")
+                          + _req(path="/slow", body=b"second"))
+                t0 = time.monotonic()
+                readable = []
+                while time.monotonic() - t0 < 0.45:
+                    srv.flush_hint()
+                    readable, _, _ = select.select([s], [], [], 0.02)
+                    if readable:
+                        break
+                assert readable, "hint never flushed the deferred response"
+                assert time.monotonic() - t0 < 0.45
+                status, body, _ = _read_response(f)
+                assert status == 200 and body == b"POST /echo first"
+                status, body, _ = _read_response(f)
+                assert status == 200 and body == b"POST /slow second"
+        finally:
+            _stop_wire(srv, t)
+
+    def test_trace_stamp_carries_reactor_index(self):
+        stamps = []
+
+        def stamp_new(t0):
+            st = types.SimpleNamespace(reactor=None)
+            stamps.append(st)
+            return st
+
+        set_trace_hooks(stamp_new, None)
+        try:
+            srv = SelectorWire(("127.0.0.1", 0), _echo, workers=1,
+                               index=7)
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            try:
+                with _connect(srv) as s, s.makefile("rb") as f:
+                    s.sendall(_req(body=b"x"))
+                    status, _, _ = _read_response(f)
+                    assert status == 200
+            finally:
+                _stop_wire(srv, t)
+        finally:
+            set_trace_hooks(None, None)
+        assert stamps and stamps[0].reactor == 7
+
+
+# -- sharded reactors ---------------------------------------------------------
+
+def _run_sharded(n=3):
+    srv = ShardedWire(("127.0.0.1", 0), _echo, reactors=n, workers=2)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+class TestShardedWire:
+    def test_reuse_port_shards_keepalive_connections(self):
+        if not hasattr(socket, "SO_REUSEPORT"):
+            pytest.skip("SO_REUSEPORT unavailable on this platform")
+        srv, t = _run_sharded(3)
+        try:
+            assert srv.reuse_port is True
+            assert all(r._listener is not None for r in srv.reactors)
+            for i in range(12):
+                with _connect(srv) as s, s.makefile("rb") as f:
+                    for j in range(2):    # keep-alive reuse per conn
+                        s.sendall(_req(body=b"c%d-%d" % (i, j)))
+                        status, body, _ = _read_response(f)
+                        assert status == 200
+                        assert body == b"POST /echo c%d-%d" % (i, j)
+            snap = srv.stats_snapshot()
+            assert snap["reactor"] == -1      # the aggregate row
+            assert snap["requests"] == 24 and snap["responses"] == 24
+            assert snap["accepted"] == 12
+            per = snap["reactors"]
+            assert [p["reactor"] for p in per] == [0, 1, 2]
+            assert sum(p["accepted"] for p in per) == 12
+        finally:
+            _stop_wire(srv, t)
+
+    def test_fallback_round_robin_spreads_accepts(self, monkeypatch):
+        monkeypatch.delattr(socket, "SO_REUSEPORT", raising=False)
+        srv, t = _run_sharded(3)
+        try:
+            assert srv.reuse_port is False
+            assert srv.reactors[0]._listener is not None
+            assert all(r._listener is None for r in srv.reactors[1:])
+            for i in range(12):
+                with _connect(srv) as s, s.makefile("rb") as f:
+                    s.sendall(_req(body=b"f%d" % i))
+                    status, body, _ = _read_response(f)
+                    assert status == 200
+                    assert body == b"POST /echo f%d" % i
+            snap = srv.stats_snapshot()
+            assert snap["responses"] == 12
+            # the deal is strict round-robin, so sequential connects
+            # land a third on every reactor
+            assert [p["accepted"] for p in snap["reactors"]] == [4, 4, 4]
+        finally:
+            _stop_wire(srv, t)
+
+    def test_sharded_pipelining_in_order(self):
+        srv, t = _run_sharded(2)
+        try:
+            n = 8
+            with _connect(srv) as s, s.makefile("rb") as f:
+                s.sendall(b"".join(_req(body=b"s%d" % i)
+                                   for i in range(n)))
+                for i in range(n):
+                    status, body, _ = _read_response(f)
+                    assert status == 200
+                    assert body == b"POST /echo s%d" % i
+        finally:
+            _stop_wire(srv, t)
+
+    def test_shutdown_drains_every_reactor(self, monkeypatch):
+        """One in-flight /slow request per reactor (the fd-handoff deal
+        is deterministic, so three sequential connects land on reactors
+        1, 2, 0); shutdown() must deliver all three responses — no
+        reactor may strand its connection."""
+        monkeypatch.delattr(socket, "SO_REUSEPORT", raising=False)
+        srv, t = _run_sharded(3)
+        socks, files = [], []
+        try:
+            for i in range(3):
+                s = _connect(srv)
+                socks.append(s)
+                files.append(s.makefile("rb"))
+            for i, s in enumerate(socks):
+                s.sendall(_req(path="/slow", body=b"d%d" % i))
+            time.sleep(0.2)      # every reactor has pumped its request
+            srv.shutdown()
+            for i, f in enumerate(files):
+                status, body, _ = _read_response(f)
+                assert status == 200 and body == b"POST /slow d%d" % i
+        finally:
+            for f in files:
+                f.close()
+            for s in socks:
+                s.close()
+            srv.server_close()
+            t.join(timeout=5)
+
+
+# -- binary query framing -----------------------------------------------------
+
+class TestBinaryCodec:
+    def test_round_trip_boundary_shapes(self):
+        for user, num in [
+            ("", 0), ("u", 1),
+            ("a" * 31, 127),          # fixstr / positive-fixint edges
+            ("a" * 32, 128),          # str8 / uint16 edges
+            ("a" * 255, 0xffff),
+            ("a" * 256, 0x10000),     # str16 / int32 edges
+            ("ünïcødé漢", -1), ("u", -32), ("u", -33),
+            ("u", 999_999_999), ("u", -999_999_999),
+        ]:
+            frame = encode_bin_query(user, num)
+            assert decode_bin_query(frame) == (user, num), (user, num)
+
+    def test_encode_rejects_out_of_range(self):
+        with pytest.raises(ValueError):
+            encode_bin_query("u", 1_000_000_000)
+        with pytest.raises(ValueError):
+            encode_bin_query("u", -1_000_000_000)
+        with pytest.raises(ValueError):
+            encode_bin_query("x" * 70000, 1)
+
+    def test_decode_rejects_malformed(self):
+        good = encode_bin_query("abc", 12)
+        assert decode_bin_query(good) == ("abc", 12)
+        assert decode_bin_query(good + b"\x00") is None     # trailing
+        assert decode_bin_query(good[:-1]) is None          # truncated
+        assert decode_bin_query(b"") is None
+        assert decode_bin_query(b'{"user": "u", "num": 1}') is None
+        # keys out of order are not the canonical frame
+        assert decode_bin_query(b"\x82\xa3num\x01\xa4user\xa1u") is None
+        # invalid UTF-8 in the user id
+        bad = bytearray(encode_bin_query("ab", 1))
+        bad[7] = 0xff
+        assert decode_bin_query(bytes(bad)) is None
+        # int32-coded num over the JSON-parity cap
+        over = (b"\x82\xa4user\xa1u\xa3num\xd2"
+                + (1_000_000_000).to_bytes(4, "big", signed=True))
+        assert decode_bin_query(over) is None
+
+    def test_fuzz_accept_containment(self):
+        """Every frame the binary decoder accepts must read the same
+        (user, num) the JSON route would serve for the equivalent body
+        — binary-accept is a strict subset of JSON-route-accept."""
+        rng = random.Random(0xB1AB1A)
+        checked = 0
+        for _ in range(3000):
+            roll = rng.random()
+            if roll < 0.4:
+                user = "".join(chr(rng.randrange(32, 0x2fff))
+                               for _ in range(rng.randrange(0, 40)))
+                num = rng.choice(
+                    [0, 1, -1, 127, 128, -32, -33,
+                     rng.randrange(-999_999_999, 10**9)])
+                frame = encode_bin_query(user, num)
+            elif roll < 0.8:
+                # mutate a canonical frame: flip/insert/delete one byte
+                frame = bytearray(encode_bin_query("abc", 12))
+                op = rng.randrange(3)
+                pos = rng.randrange(len(frame))
+                if op == 0:
+                    frame[pos] = rng.randrange(256)
+                elif op == 1:
+                    frame.insert(pos, rng.randrange(256))
+                else:
+                    del frame[pos]
+                frame = bytes(frame)
+            else:
+                frame = bytes(rng.randrange(256)
+                              for _ in range(rng.randrange(0, 24)))
+            got = decode_bin_query(frame)
+            if got is None:
+                continue
+            checked += 1
+            user, num = got
+            body = json.dumps({"user": user, "num": num}).encode("utf-8")
+            assert _parse_generic(body) == got, frame
+        assert checked > 500      # the fuzz actually hit the codec
