@@ -1107,6 +1107,128 @@ def _trace_overhead_rounds(hammer, rounds=8):
     return best
 
 
+def bench_obs(u, i, r, n_users, n_items):
+    """Continuous-observatory overhead gate: the bench_wire keep-alive
+    hammer three ways, interleaved best-of-N — observatory fully off
+    (baseline), hooks installed with the sampler off (the PIO_PROF_HZ=0
+    promise; gate <= 0.5%), and the full default stack (19 Hz sampler +
+    tsdb scraper; gate <= 1%)."""
+    import gc as _gc
+    import http.client as _hc
+
+    from predictionio_tpu.obs import profiler as prof_mod
+    from predictionio_tpu.obs import tsdb as tsdb_mod
+
+    server, _registry, _engine = _deploy_server(u, i, r, n_users, n_items)
+    payloads = [json.dumps({"user": f"u{q % n_users}", "num": 10}).encode()
+                for q in range(256)]
+    n_threads, per_thread = 8, 150
+
+    def _hammer(reuse):
+        conns = {}
+
+        def req(i):
+            tid = i // per_thread
+            c = conns.get(tid) if reuse else None
+            if c is None:
+                c = _hc.HTTPConnection("127.0.0.1", server.port,
+                                       timeout=30)
+                if reuse:
+                    conns[tid] = c
+            c.request("POST", "/queries.json",
+                      body=payloads[i % len(payloads)],
+                      headers={"Content-Type": "application/json"})
+            resp = c.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"status {resp.status}")
+            if not reuse:
+                c.close()
+
+        dt = _fanout(req, n_threads, per_thread)
+        for c in conns.values():
+            c.close()
+        return n_threads * per_thread / dt
+
+    prof = prof_mod.get_profiler()
+    if prof.hz <= 0:
+        prof.hz = prof_mod.DEFAULT_HZ    # bench the default, not the env
+
+    def _strip_gc_hooks():
+        _gc.callbacks[:] = [
+            cb for cb in _gc.callbacks
+            if getattr(cb, "__module__", "") != prof_mod.__name__]
+        prof_mod._gc_registries.clear()   # so reinstall re-hooks
+
+    def _enter_off():
+        prof.stop()
+        scraper, server._scraper = server._scraper, None
+        if scraper is not None:
+            scraper.stop()
+        _strip_gc_hooks()
+
+    def _enter_prof_off():
+        prof.stop()
+        prof_mod.install_gc_callbacks(server.metrics)
+        if server._scraper is None:
+            server._scraper = tsdb_mod.Scraper(
+                server.tsdb, server.metrics,
+                collectors=server._obs_collectors())
+            server._scraper.start()
+
+    def _enter_prof_19hz():
+        _enter_prof_off()
+        prof.start()
+
+    modes = {"off": _enter_off, "prof_off": _enter_prof_off,
+             "prof_19hz": _enter_prof_19hz}
+    best = {m: 0.0 for m in modes}
+    try:
+        for q in range(20):
+            _post(server.port, {"user": f"u{q}", "num": 10})   # warm
+        # the 0.5% gate sits well under 1-core run-to-run noise; the
+        # per-mode best needs more rounds than the trace bench's 1%/3%
+        # gates to converge
+        for _ in range(12):
+            for mode, enter in modes.items():
+                enter()
+                best[mode] = max(best[mode], _hammer(True))
+        # while the full stack is live, the endpoints must serve
+        c = _hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            for path, want in (("/profile.json", b'"running": true'),
+                               ("/tsdb.json", b'"series"')):
+                c.request("GET", path)
+                resp = c.getresponse()
+                payload = resp.read()
+                if resp.status != 200 or want not in payload:
+                    raise SystemExit(
+                        f"obs bench: {path} unhealthy under load "
+                        f"(status {resp.status})")
+        finally:
+            c.close()
+    finally:
+        # back to the state HTTPServerBase.start() leaves behind
+        prof_mod.install_gc_callbacks(server.metrics)
+        prof_mod.ensure_started()
+        server.shutdown()
+
+    base_qps = best["off"]
+    emit("obs_baseline_qps", base_qps, "qps", 1.0)
+    emit("obs_prof19_qps", best["prof_19hz"], "qps",
+         best["prof_19hz"] / max(base_qps, 1e-9))
+    for mode, budget in (("prof_off", 0.005), ("prof_19hz", 0.01)):
+        overhead = max(base_qps / max(best[mode], 1e-9) - 1.0, 0.0)
+        emit(f"obs_overhead_{mode}", overhead * 100.0, "pct",
+             1.0 if overhead <= budget else budget / overhead)
+        if overhead > budget:
+            raise SystemExit(
+                f"obs: observatory overhead ({mode}) "
+                f"{overhead * 100.0:.2f}% > {budget * 100.0:.1f}% gate "
+                f"(baseline {base_qps:.0f} qps, "
+                f"{mode} {best[mode]:.0f} qps)")
+
+
 def bench_serving(u, i, r, n_users, n_items):
     from predictionio_tpu.serving import PredictionServer, ServerConfig
 
@@ -3009,7 +3131,110 @@ def _setup_runtime():
         print(f"# device probe: {platform}", file=sys.stderr)
 
 
+# -- regression sentinel ------------------------------------------------------
+# `bench.py --compare [RESULTS]` diffs a run's metric records against
+# the newest committed BENCH_r*.json. RESULTS is a file of bench JSON
+# lines (or a BENCH_r*.json-shaped file); "-"/omitted reads stdin, so
+# `python bench.py --only-wire | python bench.py --compare` gates a
+# section run directly.
+
+# direction inferred from unit; units in neither set (and "pct", whose
+# members are overhead percentages already hard-gated in-section with
+# near-zero baselines that make relative deltas meaningless) are
+# reported but never gated
+_HIGHER_BETTER_UNITS = {"qps", "ratio", "responses_per_flush",
+                        "rows_per_s", "x"}
+_LOWER_BETTER_UNITS = {"ns_per_query", "ns_per_response", "ns", "ms",
+                       "s", "seconds", "bytes", "mb"}
+
+
+def _bench_records(obj_lines):
+    """metric -> (value, unit) from an iterable of JSON-ish lines or a
+    parsed BENCH_r*.json dict."""
+    if isinstance(obj_lines, dict):
+        rows = obj_lines.get("parsed", [])
+    else:
+        rows = []
+        for line in obj_lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                rows.append(rec)
+    return {r["metric"]: (float(r["value"]), r.get("unit", ""))
+            for r in rows
+            if isinstance(r.get("value"), (int, float))}
+
+
+def _newest_committed_bench(root):
+    """Highest-numbered BENCH_r*.json next to bench.py."""
+    import glob
+    import re as _re
+    best_n, best_path = -1, None
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best_n, best_path = int(m.group(1)), path
+    return best_path
+
+
+def _compare_main(results_path, tolerance=0.2):
+    root = os.path.dirname(os.path.abspath(__file__))
+    base_path = _newest_committed_bench(root)
+    if base_path is None:
+        print("# compare: no committed BENCH_r*.json found",
+              file=sys.stderr)
+        return 2
+    with open(base_path) as f:
+        base = _bench_records(json.load(f))
+    if results_path and results_path != "-":
+        with open(results_path) as f:
+            text = f.read()
+        try:
+            cur = _bench_records(json.loads(text))
+        except ValueError:
+            cur = _bench_records(text.splitlines())
+    else:
+        cur = _bench_records(sys.stdin)
+    shared = sorted(set(base) & set(cur))
+    if not shared:
+        print(f"# compare: no shared metrics with "
+              f"{os.path.basename(base_path)}", file=sys.stderr)
+        return 2
+    print(f"# compare vs {os.path.basename(base_path)} "
+          f"(tolerance ±{tolerance * 100:.0f}%)")
+    print(f"{'metric':<36} {'baseline':>12} {'current':>12} "
+          f"{'delta':>8}  verdict")
+    regressions = 0
+    for metric in shared:
+        bval, bunit = base[metric]
+        cval, _ = cur[metric]
+        delta = (cval - bval) / abs(bval) if abs(bval) > 1e-12 else 0.0
+        if bunit in _HIGHER_BETTER_UNITS:
+            bad = delta < -tolerance
+        elif bunit in _LOWER_BETTER_UNITS:
+            bad = delta > tolerance
+        else:
+            bad = False
+        verdict = "REGRESSION" if bad else "ok"
+        if bad:
+            regressions += 1
+        print(f"{metric:<36} {bval:>12.4g} {cval:>12.4g} "
+              f"{delta * 100:>+7.1f}%  {verdict}")
+    print(f"# compare: {len(shared)} shared metrics, "
+          f"{regressions} regression(s)")
+    return 1 if regressions else 0
+
+
 def main():
+    if "--compare" in sys.argv:
+        idx = sys.argv.index("--compare")
+        arg = sys.argv[idx + 1] if len(sys.argv) > idx + 1 else None
+        raise SystemExit(_compare_main(arg))
     if "--only-pevlog" in sys.argv:
         # jax-free section: skip the device probe (it would stall up to
         # 180 s on a dead tunnel for a device this path never touches)
@@ -3051,6 +3276,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_wire, u, i, r, n_users, n_items)
         return
+    if "--only-obs" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_obs, u, i, r, n_users, n_items)
+        return
     if "--only-serving" in sys.argv:
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_serving, u, i, r, n_users, n_items)
@@ -3082,6 +3311,7 @@ def main():
         section(bench_seqrec)
         section(bench_serving, u, i, r, n_users, n_items)
         section(bench_wire, u, i, r, n_users, n_items)
+        section(bench_obs, u, i, r, n_users, n_items)
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)

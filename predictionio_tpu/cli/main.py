@@ -212,6 +212,13 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("adminserver")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=7071)
+    x = sub.add_parser("top", help="terminal observatory view of a running "
+                       "server (qps, p50/p99, shed, burn, RSS, top frames "
+                       "from /tsdb.json + /profile.json)")
+    x.add_argument("--host", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                   help="redraw every N seconds (0 = one-shot)")
 
     # service ops (bin/pio-start-all, pio-stop-all, pio-daemon) ------------
     x = sub.add_parser("start-all", help="start event server + dashboard + "
@@ -458,6 +465,9 @@ def main(argv: Optional[list] = None) -> int:
             print(f"Admin server started on {args.ip}:{port}", flush=True)
             _serve_forever(server)
             return 0
+        if cmd == "top":
+            from predictionio_tpu.tools.admin import run_top
+            return run_top(args.host, args.port, watch_s=args.watch)
         if cmd == "status":
             _emit(ops.status(_registry()))
             return 0

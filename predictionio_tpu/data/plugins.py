@@ -63,7 +63,8 @@ class EventServerPluginContext:
 
     def _ensure_worker(self) -> None:
         if self._worker is None:
-            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker = threading.Thread(target=self._drain, daemon=True,
+                                            name="pio-plugin-drain-event")
             self._worker.start()
 
     def _drain(self) -> None:

@@ -63,6 +63,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -260,6 +261,13 @@ class FleetServer(HTTPServerBase):
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._fleet_obs = _fleet_metrics(self.metrics)
+        # metrics federation: last-good member /metrics text by member
+        # key (scraped over the upstream pool on the tsdb tick,
+        # re-served at /federate with a `member` label) plus the
+        # previous parsed sample per member for rate/p99 derivation
+        self._federate_lock = threading.Lock()
+        self._federated: Dict[str, str] = {}
+        self._member_prom_last: Dict[str, tuple] = {}
         # leadership: holder identity is the advertised address; the
         # lease DAO lives in the store every router shares. Until the
         # first lease tick this router is NOT leader (no routing).
@@ -713,6 +721,90 @@ class FleetServer(HTTPServerBase):
         self._fleet_obs["size"].set(float(len(members)))
         self._fleet_obs["members"].set(float(len(members)))
 
+    # -- metrics federation -------------------------------------------------
+    def _obs_collectors(self):
+        """The router's tsdb tick additionally scrapes every admitted
+        member, so derived per-member gauges land in the router's own
+        ring (one `/tsdb.json` holds the whole fleet's history)."""
+        return super()._obs_collectors() + [self._scrape_members]
+
+    def _scrape_members(self) -> None:
+        """Pull each admitted member's /metrics over the persistent
+        upstream pool: cache the text for /federate and derive
+        per-member qps/p99/burn/reactor-balance gauges. A failed
+        scrape feeds the suspicion machinery (it is data-path-adjacent
+        evidence, but a scrape is not a client request — so it counts
+        as probe-grade suspicion, never a lone ejection cause) and
+        keeps the member's last-good text serving."""
+        for rep in list(self._replicas):
+            if not rep.admitted:
+                continue
+            try:
+                status, _rh, body = self._upstream.request(
+                    rep.host, rep.port, "GET", "/metrics", None, {},
+                    timeout=2.0)
+                if status != 200:
+                    raise OSError(f"scrape status {status}")
+            except OSError as e:
+                self._fleet_obs["scrapes"].labels(outcome="error").inc()
+                self._record_failure(
+                    rep, f"metrics scrape failed: {e}")
+                continue
+            text = body.decode("utf-8", "replace")
+            with self._federate_lock:
+                self._federated[rep.key] = text
+            self._fleet_obs["scrapes"].labels(outcome="ok").inc()
+            try:
+                self._derive_member_gauges(rep.key, text)
+            except (ValueError, KeyError, ZeroDivisionError):
+                pass              # malformed exposition: text still federates
+
+    def _derive_member_gauges(self, member: str, text: str) -> None:
+        """Fold one member scrape into `pio_fleet_member_*` gauges.
+        Counters need two sightings (rates are deltas over the scrape
+        interval); gauges land immediately."""
+        now = time.monotonic()
+        parsed = _parse_prom(text)
+        prev = self._member_prom_last.get(member)
+        self._member_prom_last[member] = (now, parsed)
+        obs = self._fleet_obs
+        burn = 0.0
+        for (name, labels), v in parsed.items():
+            if (name == "pio_slo_burn_rate"
+                    and dict(labels).get("window") == "5m"):
+                burn = max(burn, v)
+        obs["member_burn"].labels(member=member).set(burn)
+        if prev is None:
+            return
+        pts, pparsed = prev
+        dt = now - pts
+        if dt <= 0:
+            return
+
+        def _sum(cur: Dict, name: str) -> float:
+            return sum(v for (n, _l), v in cur.items() if n == name)
+
+        dreq = (_sum(parsed, "pio_http_requests_total")
+                - _sum(pparsed, "pio_http_requests_total"))
+        if dreq >= 0:
+            obs["member_qps"].labels(member=member).set(dreq / dt)
+        obs["member_p99"].labels(member=member).set(
+            _prom_hist_p99(parsed, pparsed,
+                           "pio_http_request_duration_seconds_bucket"))
+        # reactor balance: max/mean of per-reactor request deltas
+        # (1.0 = perfectly balanced accept sharding)
+        per_reactor: Dict[str, float] = {}
+        for (name, labels), v in parsed.items():
+            if name == "pio_wire_requests_total":
+                r = dict(labels).get("reactor", "0")
+                pv = pparsed.get((name, labels), 0.0)
+                per_reactor[r] = per_reactor.get(r, 0.0) + (v - pv)
+        deltas = [d for d in per_reactor.values() if d >= 0]
+        if deltas and sum(deltas) > 0:
+            mean = sum(deltas) / len(deltas)
+            obs["member_balance"].labels(member=member).set(
+                max(deltas) / mean if mean > 0 else 1.0)
+
     # -- routing ------------------------------------------------------------
     def _rotation(self) -> List[_Replica]:
         """Admitted members, round-robin rotated so consecutive
@@ -1081,10 +1173,36 @@ class FleetServer(HTTPServerBase):
             status = 500 if report["aborted"] else 200
             return Response.json(report, status=status)
 
+        @r.get("/fleet.html")
+        def fleet_html(req: Request) -> Response:
+            from predictionio_tpu.tools.dashboard import _fleet_page
+            return Response.html(_fleet_page(
+                self.tsdb, [rep.snapshot() for rep in self._replicas]))
+
+        @r.get("/federate")
+        def federate(req: Request) -> Response:
+            # every admitted member's last-good /metrics text with a
+            # `member` label injected per sample — one scrape target
+            # for the whole fleet. A dead member keeps serving its
+            # last-good text until ejection removes it from scraping;
+            # the endpoint itself never errors on member failures.
+            with self._federate_lock:
+                items = sorted(self._federated.items())
+            out: List[str] = []
+            for member, text in items:
+                for line in text.splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    out.append(_federate_line(line, member))
+            return Response.text(
+                "\n".join(out) + ("\n" if out else ""),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
+
         @r.post("/stop")
         def stop(req: Request) -> Response:
             self.auth.check(req)
-            threading.Thread(target=self.stop, daemon=True).start()
+            threading.Thread(target=self.stop, daemon=True,
+                             name="pio-fleet-stop").start()
             return Response.json({"message": "Fleet shutting down"})
 
 
@@ -1174,8 +1292,100 @@ class ReplicaAgent:
             self._beat_all("/fleet/heartbeat")
 
 
+_PROM_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prom(text: str) -> Dict[tuple, float]:
+    """Prometheus text exposition -> {(name, sorted-label-tuple):
+    value}. Tolerant: unparseable lines are skipped (a member running
+    a newer build must still federate)."""
+    out: Dict[tuple, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        if not head:
+            continue
+        try:
+            value = float(val)  # lint: ok — host str
+        except ValueError:
+            continue
+        brace = head.find("{")
+        if brace < 0:
+            out[(head, ())] = value
+        else:
+            labels = tuple(sorted(_PROM_LABEL_RE.findall(head[brace:])))
+            out[(head[:brace], labels)] = value
+    return out
+
+
+def _prom_hist_p99(parsed: Dict[tuple, float], prev: Dict[tuple, float],
+                   bucket_name: str) -> float:
+    """p99 over the delta histogram between two scrapes, aggregated
+    across every series of `bucket_name` (in-bucket linear
+    interpolation, the registry's own estimator). 0.0 when the
+    interval saw no observations."""
+    by_le: Dict[float, float] = {}
+    for (name, labels), v in parsed.items():
+        if name != bucket_name:
+            continue
+        le_s = dict(labels).get("le", "+Inf")
+        le = float("inf") if le_s == "+Inf" else float(le_s)  # lint: ok — host str
+        delta = v - prev.get((name, labels), 0.0)
+        if delta > 0:
+            by_le[le] = by_le.get(le, 0.0) + delta
+    if not by_le:
+        return 0.0
+    bounds = sorted(by_le)
+    total = by_le[bounds[-1]] if bounds[-1] == float("inf") else max(
+        by_le.values())
+    if total <= 0:
+        return 0.0
+    target = 0.99 * total
+    lower = 0.0
+    prev_cum = 0.0
+    for le in bounds:
+        cum = by_le[le]
+        if cum >= target:
+            if le == float("inf"):
+                return lower
+            span = cum - prev_cum
+            frac = ((target - prev_cum) / span) if span > 0 else 1.0
+            return lower + (le - lower) * frac
+        prev_cum = cum
+        lower = le if le != float("inf") else lower
+    return lower
+
+
+def _federate_line(line: str, member: str) -> str:
+    """Inject `member=` into one exposition sample line."""
+    head, _, val = line.rpartition(" ")
+    if head.endswith("}"):
+        return f'{head[:-1]},member="{member}"}} {val}'
+    return f'{head}{{member="{member}"}} {val}'
+
+
 def _fleet_metrics(metrics: MetricsRegistry):
     return {
+        "scrapes": metrics.counter(
+            "pio_fleet_metrics_scrapes_total",
+            "Member /metrics federation scrapes by outcome",
+            labels=("outcome",)),
+        "member_qps": metrics.gauge(
+            "pio_fleet_member_qps",
+            "Per-member HTTP request rate derived from federation "
+            "scrapes", labels=("member",)),
+        "member_p99": metrics.gauge(
+            "pio_fleet_member_p99_seconds",
+            "Per-member request p99 over the last scrape interval",
+            labels=("member",)),
+        "member_burn": metrics.gauge(
+            "pio_fleet_member_burn",
+            "Per-member worst 5m SLO burn rate", labels=("member",)),
+        "member_balance": metrics.gauge(
+            "pio_fleet_member_reactor_balance",
+            "Per-member max/mean reactor request skew (1.0 = balanced)",
+            labels=("member",)),
         "routed": metrics.counter(
             "pio_fleet_routed_total",
             "Router outcomes (ok/retried/redirected/no_replica/exhausted)",

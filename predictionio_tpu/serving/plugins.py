@@ -55,8 +55,9 @@ class EngineServerPluginContext:
         else:
             self.output_sniffers[plugin.plugin_name] = plugin
             if self._worker is None:
-                self._worker = threading.Thread(target=self._drain,
-                                                daemon=True)
+                self._worker = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name="pio-plugin-drain-serve")
                 self._worker.start()
 
     def _drain(self) -> None:

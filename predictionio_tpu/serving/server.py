@@ -642,7 +642,8 @@ class _MicroBatcher:
             if drain:
                 self._draining = True
         if drain:
-            threading.Thread(target=self._drain_loop, daemon=True).start()
+            threading.Thread(target=self._drain_loop, daemon=True,
+                             name="pio-batch-drain").start()
         timeout = self.submit_timeout_s
         if deadline is not None:
             timeout = min(timeout, max(deadline.remaining(), 0.0))
@@ -863,8 +864,8 @@ class PredictionServer(HTTPServerBase):
         self._feedback_queue: "queue.Queue" = queue.Queue(
             maxsize=config.feedback_queue_max)
         if config.feedback:
-            threading.Thread(target=self._drain_feedback,
-                             daemon=True).start()
+            threading.Thread(target=self._drain_feedback, daemon=True,
+                             name="pio-feedback-drain").start()
         # restart-recovery pass BEFORE the first model load: report-only
         # fsck + acting janitor, so a crashed train's ghost row can't
         # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables;
@@ -901,6 +902,46 @@ class PredictionServer(HTTPServerBase):
                 self, interval, stagger_s=config.refresh_stagger_s,
                 metrics=self.metrics)
             self._refresher.start()
+
+    # -- continuous observatory ---------------------------------------------
+    def _obs_collectors(self):
+        """The serve plane's tsdb tick additionally samples the live
+        plans' device residency."""
+        return super()._obs_collectors() + [self._sample_plan_bytes]
+
+    def _sample_plan_bytes(self) -> None:
+        """Device residency of the live serving plans into
+        `pio_plan_resident_bytes{device,bucket}`: bucket="factors" is
+        the pinned factor matrix's actual bytes; numbered buckets are
+        per-executable activation estimates (query block + scores +
+        indices), so a reload to a bigger catalog or bucket ladder is
+        visible in the ring."""
+        with self._dep_lock:
+            dep = self._dep
+        if dep is None:
+            return
+        gauge = self.metrics.gauge(
+            "pio_plan_resident_bytes",
+            "Device-resident bytes of live serving plans by bucket",
+            labels=("device", "bucket"))
+        for model in dep.models:
+            plan = getattr(model, "_serve_plan", None)
+            factors = getattr(plan, "factors", None)
+            if factors is None:
+                continue
+            try:
+                dev_obj = next(iter(factors.devices()))
+                device = f"{dev_obj.platform}:{dev_obj.id}"
+                nbytes = int(factors.nbytes)
+            except (AttributeError, StopIteration, TypeError):
+                continue
+            gauge.labels(device=device, bucket="factors").set(
+                float(nbytes))  # lint: ok — host int
+            rank = int(getattr(plan, "rank", 0) or 0)  # lint: ok — host int
+            k = int(getattr(plan, "k", 0) or 0)  # lint: ok — host int
+            for b in getattr(plan, "buckets", ()) or ():
+                gauge.labels(device=device, bucket=str(b)).set(
+                    float(b * (rank * 4 + k * 8)))
 
     # -- deployment lifecycle ----------------------------------------------
     def _resolve_instance(self):
@@ -1493,7 +1534,8 @@ class PredictionServer(HTTPServerBase):
         def stop(req: Request) -> Response:
             self.auth.check(req)
             # graceful: drain accepted work before the socket closes
-            threading.Thread(target=self.stop, daemon=True).start()
+            threading.Thread(target=self.stop, daemon=True,
+                             name="pio-server-stop").start()
             return Response.json({"message": "Shutting down"})
 
         @r.get("/plugins.json")

@@ -1,4 +1,4 @@
-"""Admin REST API.
+"""Admin REST API + the `pio-tpu top` terminal observatory view.
 
 Parity: `tools/.../admin/AdminAPI.scala:77-95` + `admin/CommandClient.scala`
 (experimental app CRUD over REST on :7071):
@@ -7,11 +7,20 @@ Parity: `tools/.../admin/AdminAPI.scala:77-95` + `admin/CommandClient.scala`
   POST /cmd/app               -> create app {"name": ...}
   DELETE /cmd/app/<name>      -> delete app and its data
   DELETE /cmd/app/<name>/data -> wipe app event data
+
+`top_view(host, port)` renders one screenful of a running server's
+state — qps, p50/p99, shed rate, SLO burn, RSS, and the top profiler
+frames — read entirely from the observatory endpoints (`/tsdb.json` +
+`/profile.json`), so it works against any server in the stack
+(replica, router, event server) with no extra wiring.
 """
 
 from __future__ import annotations
 
+import json
+import urllib.request
 from dataclasses import dataclass
+from typing import Dict, Optional
 
 from predictionio_tpu.core import RuntimeContext
 from predictionio_tpu.utils.http import HTTPServerBase, Request, Response
@@ -81,3 +90,94 @@ class AdminServer(HTTPServerBase):
             except ValueError as e:
                 return Response.json({"message": str(e)}, 404)
             return Response.json({"message": "data deleted"})
+
+
+# -- `pio-tpu top` ------------------------------------------------------------
+
+def _fetch_json(host: str, port: int, path: str,
+                timeout: float = 3.0) -> Dict:
+    with urllib.request.urlopen(
+            f"http://{host}:{port}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _ring_latest(series: Dict, prefix: str,
+                 agg: str = "sum") -> Optional[float]:
+    """Aggregate the most recent point of every ring series matching
+    `prefix` (sum for rates, max for burns); None when no series
+    matches — "no data yet" and "0.0" are different answers."""
+    vals = [entry["points"][-1][1]
+            for key, entry in series.items()
+            if key.startswith(prefix) and entry["points"]]
+    if not vals:
+        return None
+    return max(vals) if agg == "max" else sum(vals)
+
+
+def _fmt(v: Optional[float], pattern: str = "{:.1f}",
+         scale: float = 1.0) -> str:
+    return "-" if v is None else pattern.format(v * scale)
+
+
+def top_view(host: str, port: int, timeout: float = 3.0,
+             frames: int = 3) -> str:
+    """One screenful of a running server's vitals from /tsdb.json +
+    /profile.json. Raises OSError when the server is unreachable."""
+    ring = _fetch_json(host, port, "/tsdb.json", timeout)["series"]
+    prof = _fetch_json(host, port, "/profile.json", timeout)
+    qps = _ring_latest(ring, "pio_http_requests_total{")
+    p50 = _suffix_latest(ring, "pio_http_request_duration_seconds", ":p50")
+    p99 = _suffix_latest(ring, "pio_http_request_duration_seconds", ":p99")
+    shed = _ring_latest(ring, "pio_shed_total")
+    burn = _ring_latest(ring, "pio_slo_burn_rate", agg="max")
+    rss = _ring_latest(ring, "pio_host_rss_bytes", agg="max")
+    lines = [
+        f"pio-tpu top — {host}:{port}",
+        f"  qps {_fmt(qps):>10}    p50 {_fmt(p50, '{:.2f}ms', 1e3):>10}"
+        f"    p99 {_fmt(p99, '{:.2f}ms', 1e3):>10}",
+        f"  shed/s {_fmt(shed):>7}    burn(5m) {_fmt(burn, '{:.2f}'):>6}"
+        f"    rss {_fmt(rss, '{:.1f}MB', 1.0 / (1 << 20)):>10}",
+        f"  profiler: {prof.get('samples', 0)} samples @ "
+        f"{prof.get('hz', 0):g} Hz "
+        f"({'on' if prof.get('running') else 'off'})",
+    ]
+    for row in prof.get("top_self", [])[:frames]:
+        lines.append(f"    {row['share']:>6.1%}  {row['frame']}")
+    roles = prof.get("roles") or {}
+    if roles:
+        lines.append("  roles: " + "  ".join(
+            f"{r}={st['share']:.0%}" for r, st in list(roles.items())[:6]))
+    return "\n".join(lines)
+
+
+def _suffix_latest(series: Dict, prefix: str,
+                   suffix: str) -> Optional[float]:
+    """Max of the most recent points across series matching BOTH the
+    name prefix and the key suffix (quantile rings: `...}:p99`)."""
+    vals = [entry["points"][-1][1]
+            for key, entry in series.items()
+            if key.startswith(prefix) and key.endswith(suffix)
+            and entry["points"]]
+    return max(vals) if vals else None
+
+
+def run_top(host: str, port: int, watch_s: float = 0.0,
+            iterations: Optional[int] = None, out=print) -> int:
+    """CLI driver: one-shot by default; `--watch N` redraws every N
+    seconds until interrupted (or `iterations` screens in tests).
+    Returns a process exit code."""
+    import time
+    n = 0
+    while True:
+        try:
+            out(top_view(host, port))
+        except (OSError, ValueError) as e:
+            out(f"[ERROR] top: {type(e).__name__}: {e}")
+            return 1
+        n += 1
+        if watch_s <= 0 or (iterations is not None and n >= iterations):
+            return 0
+        try:
+            time.sleep(watch_s)
+        except KeyboardInterrupt:
+            return 0

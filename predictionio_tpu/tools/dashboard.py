@@ -75,7 +75,9 @@ class Dashboard(HTTPServerBase):
         @r.get("/metrics.html")
         def metrics_html(req: Request) -> Response:
             self.auth.check(req)
-            return Response(status=200, body=_metrics_page(self.metrics),
+            return Response(status=200,
+                            body=_metrics_page(self.metrics,
+                                               tsdb=self.tsdb),
                             content_type="text/html", headers=CORS_HEADERS)
 
         @r.get("/traces.html")
@@ -317,12 +319,146 @@ def _durability_panel(snapshot: dict) -> str:
             + "</table>")
 
 
-def _metrics_page(metrics: MetricsRegistry) -> str:
+# -- time-series sparklines ---------------------------------------------------
+
+# (chart title, tsdb key prefixes) — each chart draws every matching
+# ring series (capped) as its own labeled sparkline row
+_HISTORY_CHARTS = (
+    ("Serve qps", ("pio_http_requests_total{",)),
+    ("Request p99 (s)", ("pio_http_request_duration_seconds",)),
+    ("Shed rate", ("pio_shed_total",)),
+    ("SLO burn", ("pio_slo_burn_rate",)),
+    ("Host RSS (bytes)", ("pio_host_rss_bytes",)),
+    ("GC pause p99 (s)", ("pio_gc_pause_seconds",)),
+)
+
+_SPARK_W = 260
+_SPARK_H = 36
+_MAX_SERIES_PER_CHART = 8
+
+
+def _spark_svg(points: list, width: int = _SPARK_W,
+               height: int = _SPARK_H) -> str:
+    """One [(ts, value), ...] series as an inline SVG polyline,
+    self-normalized to its own min/max (a sparkline shows shape, the
+    label next to it shows magnitude)."""
+    if len(points) < 2:
+        return "<svg width='%d' height='%d'></svg>" % (width, height)
+    ts = [p[0] for p in points]
+    vs = [p[1] for p in points]
+    t0, t1 = ts[0], ts[-1]
+    vmin, vmax = min(vs), max(vs)
+    tspan = (t1 - t0) or 1.0
+    vspan = (vmax - vmin) or 1.0
+    coords = " ".join(
+        f"{(t - t0) / tspan * (width - 2) + 1:.1f},"
+        f"{height - 1 - (v - vmin) / vspan * (height - 2):.1f}"
+        for t, v in points)
+    return (f"<svg width='{width}' height='{height}' "
+            f"style='background:#f4f6f8'>"
+            f"<polyline points='{coords}' fill='none' stroke='#36c' "
+            "stroke-width='1.5'/></svg>")
+
+
+def _history_rows(tsdb, prefixes: tuple) -> list:
+    """Sparkline rows for every ring series matching the prefixes."""
+    exported = tsdb.to_json()["series"]
+    rows = []
+    for key in sorted(exported):
+        if not key.startswith(prefixes):
+            continue
+        if len(rows) >= _MAX_SERIES_PER_CHART:
+            rows.append("<tr><td colspan=3><small>&hellip; more "
+                        "series truncated</small></td></tr>")
+            break
+        pts = exported[key]["points"]
+        last = pts[-1][1] if pts else 0.0
+        rows.append(
+            f"<tr><td><small>{html.escape(key)}</small></td>"
+            f"<td>{_spark_svg(pts)}</td>"
+            f"<td>{last:.6g}</td></tr>")
+    return rows
+
+
+def _history_panel(tsdb) -> str:
+    """Sparkline history charts from the server's own time-series ring
+    (obs/tsdb.py): qps, p99, shed, burn, RSS, GC over the ring's
+    horizon. Empty until the scraper has ticked twice (rates need two
+    sightings)."""
+    if tsdb is None:
+        return ""
+    sections = []
+    for title, prefixes in _HISTORY_CHARTS:
+        rows = _history_rows(tsdb, prefixes)
+        if not rows:
+            continue
+        sections.append(
+            f"<h3>{html.escape(title)}</h3>"
+            "<table><tr><th>Series</th><th>History</th><th>Last</th>"
+            "</tr>" + "".join(rows) + "</table>")
+    if not sections:
+        return ("<h2>History</h2><p>No ring data yet (the tsdb "
+                "scraper needs two ticks; PIO_TSDB_INTERVAL_S=0 "
+                "disables it).</p>")
+    return ("<h2>History</h2>"
+            "<p>Raw ring: <a href='/tsdb.json'>/tsdb.json</a> "
+            "(?series=prefix &amp;since=unix-ts)</p>"
+            + "".join(sections))
+
+
+# per-member history families the fleet page charts (derived by the
+# router's federation scrape, recorded into the router's own ring)
+_FLEET_MEMBER_CHARTS = (
+    ("Member qps", ("pio_fleet_member_qps",)),
+    ("Member p99 (s)", ("pio_fleet_member_p99_seconds",)),
+    ("Member 5m burn", ("pio_fleet_member_burn",)),
+    ("Member reactor balance (max/mean)",
+     ("pio_fleet_member_reactor_balance",)),
+)
+
+
+def _fleet_page(tsdb, members: list) -> str:
+    """`/fleet.html` on the router: the membership table plus
+    per-member qps/p99/burn/reactor-balance history sparklines from
+    the router's ring — one page answers "how is the whole fleet
+    doing, and for how long has it been doing that"."""
+    rows = []
+    for s in members:
+        rows.append(
+            f"<tr><td>{html.escape(str(s.get('member', '')))}</td>"
+            f"<td>{html.escape(str(s.get('state', '')))}</td>"
+            f"<td>{s.get('admitted', False)}</td>"
+            f"<td>{s.get('failures', 0)}</td>"
+            f"<td>{s.get('beat_age_s', 0.0):.2f}s</td></tr>")
+    sections = []
+    for title, prefixes in _FLEET_MEMBER_CHARTS:
+        hrows = _history_rows(tsdb, prefixes) if tsdb is not None else []
+        if hrows:
+            sections.append(
+                f"<h3>{html.escape(title)}</h3>"
+                "<table><tr><th>Series</th><th>History</th><th>Last"
+                "</th></tr>" + "".join(hrows) + "</table>")
+    history = "".join(sections) if sections else (
+        "<p>No member history yet — the federation scrape derives "
+        "rates after two tsdb ticks.</p>")
+    return (
+        "<html><head><title>Fleet</title>"
+        "<meta http-equiv='refresh' content='5'></head>"
+        "<body><h1>Fleet observatory</h1>"
+        "<p>Federated scrape: <a href='/federate'>/federate</a> "
+        "&middot; ring: <a href='/tsdb.json'>/tsdb.json</a></p>"
+        "<table border=1><tr><th>Member</th><th>State</th>"
+        "<th>Admitted</th><th>Failures</th><th>Beat age</th></tr>"
+        + "".join(rows) + "</table>" + history + "</body></html>")
+
+
+def _metrics_page(metrics: MetricsRegistry, tsdb=None) -> str:
     """Registry snapshot as an auto-refreshing HTML table: counters and
     gauges show their value, histograms show count/sum and the estimated
     p50/p90/p99 (the same numbers /metrics exposes to a scraper), with a
     durability summary panel (breakers, fsck, janitor, retry budgets) on
-    top."""
+    top and sparkline history charts from the server's time-series ring
+    when one is passed."""
     snapshot = metrics.snapshot()
     rows = []
     for name, fam in sorted(snapshot.items()):
@@ -332,7 +468,9 @@ def _metrics_page(metrics: MetricsRegistry) -> str:
         "<meta http-equiv='refresh' content='5'></head>"
         "<body><h1>Live metrics</h1>"
         "<p>Prometheus text format: <a href='/metrics'>/metrics</a> "
-        "&middot; traces: <a href='/traces.html'>/traces.html</a></p>"
+        "&middot; traces: <a href='/traces.html'>/traces.html</a> "
+        "&middot; profile: <a href='/profile.json'>/profile.json</a></p>"
+        + _history_panel(tsdb)
         + _serving_panel(snapshot) + _slo_panel(snapshot)
         + _wire_panel(snapshot) + _tenancy_panel(snapshot)
         + _durability_panel(snapshot) +

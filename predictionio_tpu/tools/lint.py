@@ -295,6 +295,38 @@ def _check_bounded_waits(tree: ast.AST, text: str,
                    "legitimate fixed waits")
 
 
+def _check_thread_names(tree: ast.AST, text: str,
+                        rel: str) -> Iterator[str]:
+    """In predictionio_tpu/: every ``threading.Thread(...)`` must pass
+    ``name=`` — the sampling profiler attributes CPU samples to roles
+    by thread-name prefix (obs/profiler.py), so an anonymous
+    ``Thread-12`` is a hole in every /profile.json. ``# lint: ok`` on
+    the construction line is the escape hatch."""
+    if not rel.startswith("predictionio_tpu/"):
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        is_thread = (
+            (isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+             and isinstance(fn.value, ast.Name)
+             and fn.value.id == "threading")
+            or (isinstance(fn, ast.Name) and fn.id == "Thread"))
+        if not is_thread:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        yield (f"{rel}:{node.lineno}: threading.Thread without name= "
+               "— profiler role attribution needs named threads "
+               "(obs/profiler.py); pass name='pio-...' or mark "
+               "'# lint: ok'")
+
+
 def _check_urlopen_timeout(tree: ast.AST, text: str,
                            rel: str) -> Iterator[str]:
     """In serving/ and data/: every ``urlopen(`` must carry an explicit
@@ -629,6 +661,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_lines(text, rel))
     out.extend(_check_instrumentation(tree, text, rel))
     out.extend(_check_bounded_waits(tree, text, rel))
+    out.extend(_check_thread_names(tree, text, rel))
     out.extend(_check_urlopen_timeout(tree, text, rel))
     out.extend(_check_storage_writes(tree, text, rel))
     out.extend(_check_device_transfers(tree, text, rel))

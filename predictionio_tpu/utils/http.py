@@ -55,7 +55,9 @@ from urllib.parse import parse_qs, unquote, urlparse
 from predictionio_tpu.obs import (
     MetricsRegistry, get_logger, get_registry, new_request_id,
 )
+from predictionio_tpu.obs import profiler as prof_mod
 from predictionio_tpu.obs import trace
+from predictionio_tpu.obs import tsdb as tsdb_mod
 from predictionio_tpu.resilience import (
     DEADLINE_HEADER, Deadline, DeadlineExceeded, CircuitOpenError,
     InflightLimiter, OverloadedError, deadline_from_header, deadline_scope,
@@ -265,6 +267,16 @@ class HTTPServerBase:
         self.router.get("/health")(self._health_endpoint)
         self.router.get("/ready")(self._ready_endpoint)
         self.router.get("/traces.json")(self._traces_endpoint)
+        self.router.get("/profile.json")(self._profile_json_endpoint)
+        self.router.get("/profile.txt")(self._profile_txt_endpoint)
+        self.router.get("/tsdb.json")(self._tsdb_endpoint)
+        # continuous observatory: every server keeps its own bounded
+        # time-series ring over its registry, scraped on a background
+        # tick (PIO_TSDB_INTERVAL_S=0 disables the loop; the ring and
+        # endpoint stay, just empty)
+        self.tsdb = tsdb_mod.TSDB()
+        self._scraper: Optional[tsdb_mod.Scraper] = None
+        self._host_sampler = prof_mod.HostSampler(self.metrics)
         # last-seen absolute wire counters, so monotone pio_wire_*
         # counters can be advanced by delta on each /metrics scrape
         self._wire_last: Dict[str, float] = {}
@@ -296,6 +308,48 @@ class HTTPServerBase:
         ?trace_id= / ?limit=)."""
         return Response(status=200, body=trace.traces_json_body(
             req.query_get), content_type="application/json")
+
+    # -- continuous observatory ----------------------------------------------
+    def _profile_json_endpoint(self, req: Request) -> Response:
+        """Sampling-profiler snapshot: per-role CPU shares + top
+        frames by self and cumulative samples."""
+        try:
+            top = int(req.query_get("top") or 30)
+        except ValueError:
+            top = 30
+        return Response.json(
+            prof_mod.get_profiler().snapshot_json(top=max(1, top)))
+
+    def _profile_txt_endpoint(self, req: Request) -> Response:
+        """?fmt=collapsed (the default) serves flamegraph-ready
+        collapsed stacks; ?fmt=top a terminal-friendly summary."""
+        prof = prof_mod.get_profiler()
+        if (req.query_get("fmt") or "collapsed") != "collapsed":
+            snap = prof.snapshot_json(top=15)
+            lines = [f"samples={snap['samples']} hz={snap['hz']}"]
+            for role, st in snap["roles"].items():
+                lines.append(f"role {role:<12} {st['share']:>7.2%}"
+                             f"  ({st['samples']})")
+            for row in snap["top_self"]:
+                lines.append(f"self {row['share']:>7.2%}  {row['frame']}")
+            return Response.text("\n".join(lines) + "\n")
+        return Response.text(prof.collapsed())
+
+    def _tsdb_endpoint(self, req: Request) -> Response:
+        """The local time-series ring (?series=prefix,prefix &
+        ?since=unix-ts filter)."""
+        return Response.json(self.tsdb.to_json(
+            req.query_get("series"), req.query_get("since")))
+
+    def _obs_collectors(self) -> List[Callable[[], None]]:
+        """Collectors the tsdb scraper runs before each snapshot —
+        subclasses extend (fleet member scrape, device plan bytes)."""
+
+        def _device_memory() -> None:
+            prof_mod.sample_device_memory(self.metrics)
+
+        return [self._sync_wire_metrics, self._host_sampler.sample,
+                _device_memory]
 
     def _sync_wire_metrics(self) -> None:
         """Scrape the selector wire's raw counters into pio_wire_*
@@ -374,6 +428,10 @@ class HTTPServerBase:
                  "High-water mark of framed-but-unserved pipelined "
                  "requests on one connection",
                  float(rs["pipeline_hwm"])),
+                ("pio_wire_worker_utilization",
+                 "Busy fraction of the wire worker pool "
+                 "(busy_workers / workers)",
+                 float(rs.get("utilization", 0.0))),
             )
             for name, help_text, value in gauges:
                 m.gauge(name, help_text,
@@ -608,9 +666,21 @@ class HTTPServerBase:
                 self._httpd.socket, server_side=True)
         self.port = self._httpd.server_address[1]
         self._on_bound()
+        # continuous observatory: process-global sampler (one thread
+        # samples every thread once, however many servers run) + a GC
+        # pause hook per registry + this server's tsdb scraper. Both
+        # loops honor their =0 env escape inside start().
+        prof_mod.ensure_started()
+        prof_mod.install_gc_callbacks(self.metrics)
+        if self._scraper is None:
+            self._scraper = tsdb_mod.Scraper(
+                self.tsdb, self.metrics,
+                collectors=self._obs_collectors())
+        self._scraper.start()
         if background:
             self._thread = threading.Thread(
-                target=self._httpd.serve_forever, daemon=True)
+                target=self._httpd.serve_forever, daemon=True,
+                name=f"pio-http-serve-{self.port}")
             self._thread.start()
         else:
             self._httpd.serve_forever()
@@ -628,6 +698,9 @@ class HTTPServerBase:
         with self._lifecycle_lock:
             httpd, self._httpd = self._httpd, None
             thread, self._thread = self._thread, None
+            scraper, self._scraper = self._scraper, None
+        if scraper is not None:
+            scraper.stop()
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()

@@ -913,6 +913,9 @@ class SelectorWire:
         out["open_conns"] = len(self._conns)
         out["queue_depth"] = self._workq.qsize()
         out["workers"] = self._n_workers
+        busy = out["busy_workers"]
+        out["utilization"] = (float(busy) / self._n_workers
+                              if self._n_workers else 0.0)
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -1047,6 +1050,8 @@ class ShardedWire:
                   "workers"):
             agg[k] = sum(s[k] for s in per)
         agg["pipeline_hwm"] = max(s["pipeline_hwm"] for s in per)
+        agg["utilization"] = (float(agg["busy_workers"]) / agg["workers"]
+                              if agg["workers"] else 0.0)
         errors: Dict[int, int] = {}
         for s in per:
             for code, cnt in s["errors"].items():

@@ -516,3 +516,48 @@ def test_tenant_growth_gate_scoped_to_tenancy_and_serving(tmp_path):
         "    return tenants\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_thread_name_gate_catches_anonymous_threads(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "bg.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "import threading\n"
+        "def f(work):\n"
+        "    t = threading.Thread(target=work, daemon=True)\n"
+        "    t.start()\n"
+        "    return t\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "threading.Thread without name=" in kinds
+
+
+def test_thread_name_gate_allows_named_and_escape(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "serving" / "bg.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import threading\n"
+        "from threading import Thread\n"
+        "def f(work):\n"
+        "    a = threading.Thread(target=work, daemon=True,\n"
+        "                         name='pio-bg-worker')\n"
+        "    b = Thread(target=work)  # lint: ok — test scaffold\n"
+        "    return a, b\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_thread_name_gate_scoped_to_package(tmp_path):
+    # tests/ and bench.py spawn throwaway threads whose names carry no
+    # role information — the gate only guards the package itself
+    ok = tmp_path / "tests" / "test_x.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "import threading\n"
+        "def f(work):\n"
+        "    return threading.Thread(target=work)\n"
+    )
+    assert not lint.run(tmp_path)
