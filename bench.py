@@ -1229,6 +1229,134 @@ def bench_obs(u, i, r, n_users, n_items):
                 f"{mode} {best[mode]:.0f} qps)")
 
 
+def bench_quality(u, i, r, n_users, n_items):
+    """Prediction-quality accumulator overhead gate: the bench_obs
+    keep-alive hammer with the per-app quality accumulators detached
+    (baseline) vs riding the serve path (the PIO_QUALITY default);
+    interleaved best-of-N, gate <= 1%. While the accumulators are
+    live, /quality.json must serve the sketch snapshot under load."""
+    import http.client as _hc
+    import logging as _logging
+
+    from predictionio_tpu.obs.quality import QualityStats
+
+    server, _registry, _engine = _deploy_server(u, i, r, n_users, n_items)
+    if server._quality is None:          # PIO_QUALITY=off in the env
+        server._quality = QualityStats(metrics=server.metrics)
+    quality = server._quality
+    payloads = [json.dumps({"user": f"u{q % n_users}", "num": 10}).encode()
+                for q in range(256)]
+    n_threads, per_thread = 8, 150
+
+    def _hammer(reuse):
+        conns = {}
+
+        def req(i):
+            tid = i // per_thread
+            c = conns.get(tid) if reuse else None
+            if c is None:
+                c = _hc.HTTPConnection("127.0.0.1", server.port,
+                                       timeout=30)
+                if reuse:
+                    conns[tid] = c
+            c.request("POST", "/queries.json",
+                      body=payloads[i % len(payloads)],
+                      headers={"Content-Type": "application/json"})
+            resp = c.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"status {resp.status}")
+            if not reuse:
+                c.close()
+
+        dt = _fanout(req, n_threads, per_thread)
+        for c in conns.values():
+            c.close()
+        return n_threads * per_thread / dt
+
+    def _enter_off():
+        server._quality = None
+
+    def _enter_on():
+        server._quality = quality
+
+    modes = {"off": _enter_off, "on": _enter_on}
+    samples = {m: [] for m in modes}
+    try:
+        # the per-request info log is a synchronous write per request —
+        # on a 1-core runner that I/O is the noise floor, and this gate
+        # measures the accumulator's marginal cost, not logging's
+        _logging.disable(_logging.INFO)
+        for q in range(20):
+            _post(server.port, {"user": f"u{q}", "num": 10})   # warm
+        # interleaved rounds with alternating order report the off/on
+        # qps medians; the GATE is computed from the directly measured
+        # per-call cost below. (End-to-end qps differencing cannot
+        # resolve 1% here: adjacent same-second hammers on this shared
+        # 1-core runner differ by +/-15%, so every qps-delta estimator
+        # — best-of, paired-ratio, per-mode medians — flakes at the
+        # gate threshold regardless of round count.)
+        for rnd in range(8):
+            order = ("off", "on") if rnd % 2 == 0 else ("on", "off")
+            for mode in order:
+                modes[mode]()
+                samples[mode].append(_hammer(True))
+        # direct marginal cost: the hot path is lock-free by design
+        # (one GIL-atomic buffer append, no cross-thread contention to
+        # capture), so a tight loop over observe_result with a REAL
+        # served result is representative — and 120k calls amortise
+        # the backstop folds of the observation buffer at their true
+        # production cadence
+        from predictionio_tpu.core import extract_params
+        dep = server._dep
+        qd = {"user": "u1", "num": 10}
+        q = (extract_params(dep.query_class, qd)
+             if dep.query_class is not None else qd)
+        result = dep.predict_batch([q])[0]
+        user_maps = dep.user_maps
+        calls = 120_000
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            quality.observe_result("", result, "u1", user_maps)
+        per_call_s = (time.perf_counter() - t0) / calls
+        # while the accumulators are live, the snapshot must serve
+        _enter_on()
+        c = _hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            c.request("GET", "/quality.json")
+            resp = c.getresponse()
+            payload = resp.read()
+            if resp.status != 200 or b'"quantiles"' not in payload:
+                raise SystemExit(
+                    f"quality bench: /quality.json unhealthy under "
+                    f"load (status {resp.status})")
+        finally:
+            c.close()
+    finally:
+        _logging.disable(_logging.NOTSET)
+        server._quality = quality
+        server.shutdown()
+
+    med = {m: sorted(v)[len(v) // 2] for m, v in samples.items()}
+    base_qps = med["off"]
+    emit("quality_baseline_qps", base_qps, "qps", 1.0)
+    emit("quality_on_qps", med["on"], "qps",
+         med["on"] / max(base_qps, 1e-9))
+    emit("quality_observe_us", per_call_s * 1e6, "us", 1.0)
+    # the accumulator's marginal cost as a fraction of one request's
+    # wall budget at the measured baseline qps — on a saturated
+    # single-core server this IS the qps overhead
+    overhead = per_call_s * base_qps
+    budget = 0.01
+    emit("quality_overhead", overhead * 100.0, "pct",
+         1.0 if overhead <= budget else budget / overhead)
+    if overhead > budget:
+        raise SystemExit(
+            f"quality: accumulator overhead {overhead * 100.0:.2f}% > "
+            f"{budget * 100.0:.1f}% gate "
+            f"({per_call_s * 1e6:.2f}us/call at {base_qps:.0f} qps)")
+
+
 def bench_serving(u, i, r, n_users, n_items):
     from predictionio_tpu.serving import PredictionServer, ServerConfig
 
@@ -3280,6 +3408,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_obs, u, i, r, n_users, n_items)
         return
+    if "--only-quality" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_quality, u, i, r, n_users, n_items)
+        return
     if "--only-serving" in sys.argv:
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_serving, u, i, r, n_users, n_items)
@@ -3312,6 +3444,7 @@ def main():
         section(bench_serving, u, i, r, n_users, n_items)
         section(bench_wire, u, i, r, n_users, n_items)
         section(bench_obs, u, i, r, n_users, n_items)
+        section(bench_quality, u, i, r, n_users, n_items)
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)

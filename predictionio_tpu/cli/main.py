@@ -176,6 +176,25 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--tenant-queue-max", type=int,
                    help="default per-app micro-batch pending cap "
                         "(PIO_TENANT_QUEUE_MAX)")
+    x.add_argument("--quality", choices=["on", "off"],
+                   help="prediction-quality observatory: score "
+                        "sketches + drift gauges on the serve path, "
+                        "the feedback-join reward loop (with "
+                        "--feedback), and the reload canary gate "
+                        "(default: the PIO_QUALITY env knob, on when "
+                        "unset)")
+    x.add_argument("--attribution-s", type=float, default=0.0,
+                   help="feedback-join attribution window, seconds "
+                        "(PIO_ATTRIBUTION_S, default 300)")
+    x.add_argument("--canary-sample", type=int, default=-1,
+                   help="traced queries replayed old-vs-new on each "
+                        "reload (PIO_CANARY_SAMPLE, default 16; 0 "
+                        "disables the canary)")
+    x.add_argument("--canary-min-overlap", type=float, default=-1.0,
+                   help="abort a (rolling) reload when the replayed "
+                        "top-k overlap falls below this "
+                        "(PIO_CANARY_MIN_OVERLAP, default 0 = "
+                        "report-only)")
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
@@ -369,7 +388,11 @@ def main(argv: Optional[list] = None) -> int:
                 mesh=args.mesh or "",
                 refresh_interval_s=args.refresh_interval,
                 server_key=registry.config.get("PIO_SERVER_ACCESS_KEY", ""),
-                tenancy=tenancy)
+                tenancy=tenancy,
+                quality=(args.quality == "on" if args.quality else None),
+                attribution_s=args.attribution_s,
+                canary_sample=args.canary_sample,
+                canary_min_overlap=args.canary_min_overlap)
             if args.join:
                 # standalone replica: serve locally, register with (and
                 # heartbeat) every router listed. The joined routers are

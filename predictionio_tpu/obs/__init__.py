@@ -29,6 +29,10 @@ from predictionio_tpu.obs.trace import (  # noqa: F401
 from predictionio_tpu.obs.slo import (  # noqa: F401
     SLOTracker, dao_overrides_loader,
 )
+from predictionio_tpu.obs.quality import (  # noqa: F401
+    CanaryGate, CanaryVeto, QualityJoiner, QualityStats,
+    QuantileSketch, js_divergence, psi, quality_enabled,
+)
 from predictionio_tpu.obs.profiler import (  # noqa: F401
     HostSampler, SamplingProfiler, ensure_started, get_profiler,
     install_gc_callbacks, role_of, sample_device_memory,

@@ -90,7 +90,8 @@ class PendingTrace:
 
     __slots__ = ("st", "trace_id", "span_id", "parent_id", "sampled",
                  "kind", "app", "route", "status", "dispatch", "error",
-                 "batch_id", "batch_size", "rid", "extra", "reactor")
+                 "batch_id", "batch_size", "rid", "extra", "reactor",
+                 "query")
 
     def __init__(self):
         self.st = [0.0] * N_STAMPS
@@ -109,6 +110,8 @@ class PendingTrace:
         self.rid = ""
         self.extra = None        # optional [(name, t0, t1), ...]
         self.reactor = -1        # accept-shard index (set by the wire)
+        self.query = None        # (user, num) tuple or query dict —
+        #                          replayable by the reload canary
 
 
 # -- X-PIO-Trace codec (signed-header compatible with X-PIO-App) -------------
@@ -318,6 +321,12 @@ class TraceRecorder:
             entry["request_id"] = p.rid
         if p.reactor >= 0:
             entry["reactor"] = p.reactor
+        q = p.query
+        if q is not None:
+            if isinstance(q, tuple):
+                entry["query"] = {"user": q[0], "num": q[1]}
+            else:
+                entry["query"] = q
         return entry
 
     def _slow_log(self, p: PendingTrace, dur: float) -> None:
@@ -507,7 +516,7 @@ def child_header(p: PendingTrace) -> str:
 def annotate(raw, status: int = 0, app: Optional[str] = None,
              route: Optional[str] = None, dispatch: Optional[str] = None,
              error: Optional[str] = None,
-             kind: Optional[str] = None) -> None:
+             kind: Optional[str] = None, query=None) -> None:
     """Attach scalar attributes to a RawRequest's pending trace —
     keyword scalars only, nothing allocated on the hot path."""
     p = raw.trace
@@ -525,13 +534,15 @@ def annotate(raw, status: int = 0, app: Optional[str] = None,
         p.error = error
     if kind is not None:
         p.kind = kind
+    if query is not None:
+        p.query = query
 
 
 def annotate_pending(p: Optional[PendingTrace], status: int = 0,
                      app: Optional[str] = None, route: Optional[str] = None,
                      dispatch: Optional[str] = None,
                      error: Optional[str] = None,
-                     kind: Optional[str] = None) -> None:
+                     kind: Optional[str] = None, query=None) -> None:
     """`annotate` for call sites that hold the PendingTrace itself."""
     if p is None:
         return
@@ -547,6 +558,8 @@ def annotate_pending(p: Optional[PendingTrace], status: int = 0,
         p.error = error
     if kind is not None:
         p.kind = kind
+    if query is not None:
+        p.query = query
 
 
 def add_span(p: Optional[PendingTrace], name: str, t0: float,

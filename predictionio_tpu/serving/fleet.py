@@ -1173,6 +1173,26 @@ class FleetServer(HTTPServerBase):
             status = 500 if report["aborted"] else 200
             return Response.json(report, status=status)
 
+        @r.get("/quality.json")
+        def quality_json(req: Request) -> Response:
+            # per-member quality snapshots, fetched live from admitted
+            # members; a member failing to answer is reported, never
+            # fatal — the quality view degrades like /federate does
+            members = {}
+            for rep in self._replicas:
+                if not rep.admitted:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{rep.host}:{rep.port}/quality.json",
+                            timeout=2) as resp:
+                        members[rep.key] = json.loads(
+                            resp.read().decode("utf-8"))
+                except (OSError, ValueError) as e:
+                    members[rep.key] = {
+                        "error": f"{type(e).__name__}: {e}"}
+            return Response.json({"role": "fleet", "members": members})
+
         @r.get("/fleet.html")
         def fleet_html(req: Request) -> Response:
             from predictionio_tpu.tools.dashboard import _fleet_page

@@ -48,6 +48,9 @@ from predictionio_tpu.core.workflow import CoreWorkflow, resolve_engine
 from predictionio_tpu.data.event import format_time, utcnow
 from predictionio_tpu.obs import MetricsRegistry, get_logger, get_registry
 from predictionio_tpu.obs import trace
+from predictionio_tpu.obs.quality import (
+    CanaryGate, QualityStats, quality_enabled,
+)
 from predictionio_tpu.obs.slo import SLOTracker, dao_overrides_loader
 from predictionio_tpu.resilience import (
     DEADLINE_HEADER, CircuitOpenError, Deadline, DeadlineExceeded,
@@ -116,14 +119,16 @@ class _ServeInstruments:
             "pio_queue_delay_seconds",
             "Micro-batch enqueue->drain latency (feeds the adaptive "
             "shed decision)")
+        # `app` on the feedback families follows the shed-metric
+        # convention: the authenticated tenant, "" with tenancy off
         self.feedback = metrics.counter(
             "pio_feedback_events_total",
             "Feedback events by outcome (sent/failed/dropped)",
-            labels=("outcome",))
+            labels=("outcome", "app"))
         self.feedback_dropped = metrics.counter(
             "pio_feedback_dropped_total",
             "Feedback events dropped (queue full / send retries "
-            "exhausted)", labels=("reason",))
+            "exhausted)", labels=("reason", "app"))
         # the `app` label is the shedding tenant ("" on surfaces with no
         # tenant attribution — HTTP-plane inflight, fleet pre-dial)
         self.shed = metrics.counter(
@@ -204,6 +209,19 @@ class ServerConfig:
     # the exact pre-tenancy code shape). FleetServer hands replicas a
     # trust-header variant of the leader's config.
     tenancy: Optional[TenancyConfig] = None
+    # prediction-quality observatory (obs/quality.py): None = the
+    # PIO_QUALITY env knob (default on; the accumulators are
+    # allocation-light and gauge sync is amortised)
+    quality: Optional[bool] = None
+    # feedback-join attribution window in seconds; <= 0 = the
+    # PIO_ATTRIBUTION_S env knob (default 300)
+    attribution_s: float = 0.0
+    # reload canary: traced queries replayed old-vs-new per reload
+    # (< 0 = PIO_CANARY_SAMPLE, default 16; 0 disables the check) and
+    # the overlap below which the reload is vetoed (< 0 =
+    # PIO_CANARY_MIN_OVERLAP, default 0 = report-only)
+    canary_sample: int = -1
+    canary_min_overlap: float = -1.0
 
 
 def to_jsonable(obj: Any) -> Any:
@@ -354,6 +372,11 @@ class _Deployment:
         # fits the compiled shape — derived once here, consulted per
         # request with a single attribute read
         self.fast_ctor = _derive_fast_ctor(self.query_class)
+        # entity maps consulted by the quality accumulators' cold-start
+        # (unknown-entity) detection — derived once, read per request
+        self.user_maps = tuple(
+            um for um in (getattr(m, "users", None) for m in models)
+            if um is not None and hasattr(um, "get"))
 
     def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
         """supplement -> per-algo batch_predict -> serve, for a batch;
@@ -880,6 +903,17 @@ class PredictionServer(HTTPServerBase):
             startup_check(self.ctx.registry, log=_log.warning)
             self._fsck_sched = start_scheduled_fsck(
                 self.ctx.registry, log=_log.warning)
+        # prediction-quality observatory: serve-path accumulators +
+        # the reload canary gate (PIO_QUALITY=off disables both)
+        q_on = (config.quality if config.quality is not None
+                else quality_enabled())
+        self._quality = (QualityStats(metrics=self.metrics)
+                         if q_on else None)
+        self._canary = (CanaryGate(
+            sample=config.canary_sample,
+            min_overlap=config.canary_min_overlap,
+            metrics=self.metrics) if q_on else None)
+        self._joiner = None
         # warm-start the topk dispatch policy from the last run's learned
         # host/device crossover before any serve traffic arrives
         self._restore_dispatch_state()
@@ -902,6 +936,14 @@ class PredictionServer(HTTPServerBase):
                 self, interval, stagger_s=config.refresh_stagger_s,
                 metrics=self.metrics)
             self._refresher.start()
+        # the feedback joiner closes the loop the feedback writer opens:
+        # it only makes sense when this server posts feedback events
+        if config.feedback and self._quality is not None:
+            from predictionio_tpu.obs.quality import QualityJoiner
+            self._joiner = QualityJoiner(
+                self, attribution_s=config.attribution_s,
+                metrics=self.metrics)
+            self._joiner.start()
 
     # -- continuous observatory ---------------------------------------------
     def _obs_collectors(self):
@@ -957,9 +999,9 @@ class PredictionServer(HTTPServerBase):
 
     def _load(self, instance=None) -> None:
         """Build a full deployment, then swap atomically. Any failure
-        (resolve, storage read, model prepare) propagates BEFORE the
-        swap, so the previous deployment — if any — keeps serving
-        untouched (graceful-degradation contract of /reload)."""
+        (resolve, storage read, model prepare, canary veto) propagates
+        BEFORE the swap, so the previous deployment — if any — keeps
+        serving untouched (graceful-degradation contract of /reload)."""
         try:
             engine = (self._engine_arg if self._engine_arg is not None
                       else resolve_engine(self.config.engine_factory))
@@ -977,16 +1019,39 @@ class PredictionServer(HTTPServerBase):
                 warm_batch_max=(self.config.batch_max
                                 if self._batcher is not None else 1),
                 observed_sizes=observed or None)
+            new_dep = _Deployment(engine, instance, algos, models,
+                                  serving, obs=self._serve_obs)
+            # reload canary: replay recently-kept traced queries
+            # against old and new plans BEFORE the swap; a CanaryVeto
+            # is a load failure — previous deployment keeps serving
+            if self._canary is not None and self._dep is not None:
+                self._canary.check(self._dep, new_dep,
+                                   self._canary_replay)
         except Exception:
             self._serve_obs.reloads.labels(outcome="failed").inc()
             raise
         with self._dep_lock:
-            self._dep = _Deployment(engine, instance, algos, models,
-                                    serving, obs=self._serve_obs)
+            self._dep = new_dep
         self._serve_obs.reloads.labels(outcome="ok").inc()
+        # each successful (re)load starts a fresh drift reference
+        # window: the new model's own scores are the new baseline
+        if self._quality is not None:
+            self._quality.freeze_reference()
         # checkpoint the learned dispatch EWMAs on every successful
         # (re)load, so the NEXT process start resumes warm
         self._save_dispatch_state()
+
+    def _canary_replay(self, dep: _Deployment,
+                       qdicts: List[Dict]) -> List[Any]:
+        """Parse + predict a batch of traced query dicts against `dep`
+        (the CanaryGate's replay callback — the gate owns sampling and
+        scoring, the server owns query parsing and the predict path)."""
+        if dep.query_class is not None:
+            queries = [extract_params(dep.query_class, qd)
+                       for qd in qdicts]
+        else:
+            queries = list(qdicts)
+        return dep.predict_batch(queries)
 
     def _refresh_deployment(self, dep: _Deployment,
                             new_models: Sequence[Any]) -> _Deployment:
@@ -1148,6 +1213,8 @@ class PredictionServer(HTTPServerBase):
             self._stopping = True
         if self._refresher is not None:
             self._refresher.stop()
+        if self._joiner is not None:
+            self._joiner.stop()
         budget = max(self.config.drain_timeout_ms / 1000.0, 0.1)
         t0 = time.perf_counter()
         if self._batcher is not None:
@@ -1187,7 +1254,8 @@ class PredictionServer(HTTPServerBase):
         p = trace.current()
         trace.annotate_pending(
             p, kind="serve",
-            app=tenant.label if tenant is not None else "")
+            app=tenant.label if tenant is not None else "",
+            query=query_json if isinstance(query_json, dict) else None)
         dep = self._dep
         with self._serve_obs.stage.labels(stage="extract").time():
             if dep.query_class is not None:
@@ -1204,12 +1272,21 @@ class PredictionServer(HTTPServerBase):
         else:
             prediction = dep.predict_batch([query])[0]
             trace.mark(p, trace.S_EXEC)
+        app = tenant.label if tenant is not None else ""
+        if self._quality is not None:
+            self._quality.observe_result(
+                app, prediction, getattr(query, "user", None),
+                dep.user_maps)
         # feedback loop + prId injection (CreateServer.scala:506-576)
         response_extra = {}
         if self.config.feedback:
             with self._serve_obs.stage.labels(stage="feedback").time():
                 pr_id = getattr(prediction, "prId", None) or _gen_pr_id()
-                self._post_feedback(dep, query, prediction, pr_id)
+                if p is not None:
+                    trace.ensure_ids(p)
+                self._post_feedback(dep, query, prediction, pr_id, app,
+                                    trace_id=(p.trace_id if p is not None
+                                              else ""))
             if hasattr(prediction, "prId"):
                 response_extra["prId"] = pr_id
         prediction = self.plugin_context.run_blockers(
@@ -1358,7 +1435,11 @@ class PredictionServer(HTTPServerBase):
         self._fq_ok.inc()
         self._fq_hist.observe(dt)
         self._slo.record(app, dt, ok=True)
-        trace.annotate(raw, status=200, app=app, route="/queries.json")
+        if self._quality is not None:
+            self._quality.observe_result(app, slot["result"], user,
+                                         dep.user_maps)
+        trace.annotate(raw, status=200, app=app, route="/queries.json",
+                       query=(user, num))
         trace.stamp(raw, trace.S_DONE)
         if raw.trace is None:
             # tracing off: direct serve-latency observation (the
@@ -1398,30 +1479,40 @@ class PredictionServer(HTTPServerBase):
                               extra or None, keep_alive=keep)
 
     def _post_feedback(self, dep: _Deployment, query, prediction,
-                       pr_id: str) -> None:
+                       pr_id: str, app: str = "",
+                       trace_id: str = "") -> None:
         """Async POST of the predict event back to the event server via a
         bounded queue drained by one worker thread (no thread-per-request
         spawn at serving throughput); sends retry with jittered backoff
         up to `feedback_retries` attempts and then DROP (counted in
         pio_feedback_dropped_total), and enqueue overflow drops the
-        event with a log line rather than stalling the serve path."""
+        event with a log line rather than stalling the serve path.
+
+        `prId` (and the trace id, when tracing is on) ride in the event
+        properties so the quality joiner — and any downstream reward
+        pipeline — joins feedback to the served prediction exactly."""
+        props = {
+            "engineInstanceId": dep.instance.id,
+            "prId": pr_id,
+            "query": to_jsonable(query),
+            "prediction": to_jsonable(prediction),
+        }
+        if trace_id:
+            props["traceId"] = trace_id
         data = {
             "event": "predict",
             "eventTime": format_time(utcnow()),
             "entityType": "pio_pr",
             "entityId": pr_id,
-            "properties": {
-                "engineInstanceId": dep.instance.id,
-                "query": to_jsonable(query),
-                "prediction": to_jsonable(prediction),
-            },
+            "properties": props,
         }
         try:
-            self._feedback_queue.put_nowait(data)
+            self._feedback_queue.put_nowait((data, app))
         except queue.Full:
-            self._serve_obs.feedback.labels(outcome="dropped").inc()
+            self._serve_obs.feedback.labels(outcome="dropped",
+                                            app=app).inc()
             self._serve_obs.feedback_dropped.labels(
-                reason="queue_full").inc()
+                reason="queue_full", app=app).inc()
             self.obs_log.warning("feedback_dropped", reason="queue full")
 
     def _send_feedback(self, data: Dict[str, Any]) -> None:
@@ -1443,22 +1534,38 @@ class PredictionServer(HTTPServerBase):
             attempts=max(1, self.config.feedback_retries),
             base_delay=0.1, max_delay=2.0, retryable=(OSError,))
         while True:
-            data = self._feedback_queue.get()
+            data, app = self._feedback_queue.get()
             try:
                 call_with_retry(self._send_feedback, data, policy=policy)
-                self._serve_obs.feedback.labels(outcome="sent").inc()
+                self._serve_obs.feedback.labels(outcome="sent",
+                                                app=app).inc()
             except Exception as e:
                 # retries exhausted (or non-transient): drop, count, move
                 # on — feedback is best-effort and must never wedge the
                 # worker
-                self._serve_obs.feedback.labels(outcome="failed").inc()
+                self._serve_obs.feedback.labels(outcome="failed",
+                                                app=app).inc()
                 self._serve_obs.feedback_dropped.labels(
-                    reason="send_failed").inc()
+                    reason="send_failed", app=app).inc()
                 self.obs_log.warning("feedback_dropped",
                                      reason="send failed", error=str(e))
             finally:
                 # unfinished_tasks bookkeeping feeds the stop() flush
                 self._feedback_queue.task_done()
+
+    def quality_snapshot(self) -> Dict[str, Any]:
+        """The `/quality.json` payload: per-app accumulators, the
+        feedback joiner's reward view, and the last canary report."""
+        out: Dict[str, Any] = {
+            "enabled": self._quality is not None,
+            "apps": (self._quality.snapshot()
+                     if self._quality is not None else {}),
+        }
+        if self._joiner is not None:
+            out["joiner"] = self._joiner.snapshot()
+        if self._canary is not None:
+            out["canary"] = self._canary.last
+        return out
 
     # -- routes ---------------------------------------------------------------
     def _routes(self) -> None:
@@ -1506,6 +1613,10 @@ class PredictionServer(HTTPServerBase):
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
             })
+
+        @r.get("/quality.json")
+        def quality_json(req: Request) -> Response:
+            return Response.json(self.quality_snapshot())
 
         @r.post("/reload")
         def reload(req: Request) -> Response:

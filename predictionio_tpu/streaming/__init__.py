@@ -26,5 +26,7 @@ only and never persisted to the model store.
 from predictionio_tpu.streaming.delta import (  # noqa: F401
     Delta, scan_delta,
 )
-from predictionio_tpu.streaming.refresher import Refresher  # noqa: F401
+from predictionio_tpu.streaming.refresher import (  # noqa: F401
+    Refresher, locate_event_store,
+)
 from predictionio_tpu.streaming.updaters import FoldContext  # noqa: F401

@@ -46,6 +46,29 @@ from predictionio_tpu.streaming.updaters import FoldContext
 _log = get_logger(__name__)
 
 
+def locate_event_store(dep, registry) -> Optional[
+        Tuple[object, int, object, dict]]:
+    """events DAO + app/channel ids from a live deployment's data
+    source params (the `{"name":..., "params": {...}}` shape the
+    workflow persists). Shared by the refresher and the quality
+    feedback joiner; None when the deployment has no locatable app."""
+    from predictionio_tpu.data.store import app_name_to_id
+    try:
+        raw = json.loads(dep.instance.data_source_params or "{}")
+    except ValueError:
+        return None
+    params = raw.get("params", {}) if isinstance(raw, dict) else {}
+    app_name = params.get("app_name")
+    if not app_name:
+        return None
+    try:
+        app_id, channel_id = app_name_to_id(
+            registry, app_name, params.get("channel"))
+    except ValueError:
+        return None
+    return registry.get_events(), app_id, channel_id, params
+
+
 def _metrics(reg: MetricsRegistry) -> dict:
     return {
         "freshness": reg.gauge(
@@ -166,25 +189,7 @@ class Refresher:
         return outcome
 
     def _locate(self, dep) -> Optional[Tuple[object, int, object, dict]]:
-        """events DAO + app/channel ids from the live deployment's data
-        source params (the `{"name":..., "params": {...}}` shape the
-        workflow persists)."""
-        from predictionio_tpu.data.store import app_name_to_id
-        try:
-            raw = json.loads(dep.instance.data_source_params or "{}")
-        except ValueError:
-            return None
-        params = raw.get("params", {}) if isinstance(raw, dict) else {}
-        app_name = params.get("app_name")
-        if not app_name:
-            return None
-        registry = self.server.ctx.registry
-        try:
-            app_id, channel_id = app_name_to_id(
-                registry, app_name, params.get("channel"))
-        except ValueError:
-            return None
-        return registry.get_events(), app_id, channel_id, params
+        return locate_event_store(dep, self.server.ctx.registry)
 
     # -- fold + commit ------------------------------------------------------
     def _fold_and_swap(self, dep, delta: Delta,

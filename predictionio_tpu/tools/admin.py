@@ -119,10 +119,36 @@ def _fmt(v: Optional[float], pattern: str = "{:.1f}",
     return "-" if v is None else pattern.format(v * scale)
 
 
+def _quality_line(host: str, port: int,
+                  timeout: float = 3.0) -> Optional[str]:
+    """One line of prediction-quality vitals from /quality.json —
+    worst 5m drift (PSI), feedback-join reward rate, and the last
+    roll's canary overlap. None when the endpoint is absent (event
+    servers, routers) or unreachable: top degrades, never errors."""
+    try:
+        q = _fetch_json(host, port, "/quality.json", timeout)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(q, dict) or not q.get("enabled", False):
+        return None
+    drifts = [w.get(k, 0.0)
+              for app in (q.get("apps") or {}).values()
+              for w in (app.get("windows") or {}).values()
+              for k in ("top1_psi", "margin_psi") if k in w]
+    rewards = [a.get("reward_rate", 0.0)
+               for a in ((q.get("joiner") or {}).get("apps")
+                         or {}).values()]
+    canary = q.get("canary") or {}
+    return (f"  drift(psi) {_fmt(max(drifts) if drifts else None, '{:.3f}'):>6}"
+            f"    reward {_fmt(max(rewards) if rewards else None, '{:.0%}'):>6}"
+            f"    canary {_fmt(canary.get('overlap'), '{:.0%}'):>6}")
+
+
 def top_view(host: str, port: int, timeout: float = 3.0,
              frames: int = 3) -> str:
     """One screenful of a running server's vitals from /tsdb.json +
-    /profile.json. Raises OSError when the server is unreachable."""
+    /profile.json (+ /quality.json where the serve plane exposes it).
+    Raises OSError when the server is unreachable."""
     ring = _fetch_json(host, port, "/tsdb.json", timeout)["series"]
     prof = _fetch_json(host, port, "/profile.json", timeout)
     qps = _ring_latest(ring, "pio_http_requests_total{")
@@ -141,6 +167,9 @@ def top_view(host: str, port: int, timeout: float = 3.0,
         f"{prof.get('hz', 0):g} Hz "
         f"({'on' if prof.get('running') else 'off'})",
     ]
+    quality = _quality_line(host, port, timeout)
+    if quality is not None:
+        lines.insert(3, quality)
     for row in prof.get("top_self", [])[:frames]:
         lines.append(f"    {row['share']:>6.1%}  {row['frame']}")
     roles = prof.get("roles") or {}

@@ -163,6 +163,11 @@ _WIRE_PREFIXES = ("pio_wire",)
 # budget; burn >= 14.4 on the 5m window is the fast-burn page threshold)
 _SLO_PREFIXES = ("pio_slo",)
 
+# prediction-quality families (obs/quality.py): score drift vs the
+# deploy-time reference, result-shape ratios, feedback-join reward, and
+# the last rolling reload's canary overlap — "is the model any good"
+_QUALITY_PREFIXES = ("pio_pred_", "pio_canary_", "pio_feedback_join")
+
 
 def _reactor_balance(snapshot: dict) -> str:
     """Per-reactor connection/request balance: one row per accept
@@ -253,6 +258,27 @@ def _slo_panel(snapshot: dict) -> str:
     if links:
         body.append("<p>p99 exemplars:</p><ul>" + "".join(links) + "</ul>")
     return "<h2>SLO burn rate</h2>" + "".join(body)
+
+
+def _quality_panel(snapshot: dict) -> str:
+    """Summary table of the prediction-quality families: drift vs the
+    deploy-time reference (PSI / JS per window), empty/unknown-entity
+    ratios, the feedback-join reward rate, and the last roll's canary
+    overlap. The raw per-app snapshot lives at /quality.json."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_QUALITY_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Prediction quality</h2>"
+                "<p>No quality telemetry recorded yet (PIO_QUALITY "
+                "off, or no queries served).</p>")
+    return ("<h2>Prediction quality</h2>"
+            "<p>Raw snapshot: <a href='/quality.json'>/quality.json"
+            "</a></p>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
 
 
 def _nearest_exemplars(series: dict) -> list:
@@ -472,6 +498,7 @@ def _metrics_page(metrics: MetricsRegistry, tsdb=None) -> str:
         "&middot; profile: <a href='/profile.json'>/profile.json</a></p>"
         + _history_panel(tsdb)
         + _serving_panel(snapshot) + _slo_panel(snapshot)
+        + _quality_panel(snapshot)
         + _wire_panel(snapshot) + _tenancy_panel(snapshot)
         + _durability_panel(snapshot) +
         "<h2>All families</h2>"

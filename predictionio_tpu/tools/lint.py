@@ -115,7 +115,8 @@ _TENANCY_DIRS = ("predictionio_tpu/tenancy/", "predictionio_tpu/serving/")
 # the serve wire hot route: files and function names on the
 # per-request path where generic JSON and dict assembly are banned
 _HOT_ROUTE_FILES = ("predictionio_tpu/serving/server.py",
-                    "predictionio_tpu/utils/wire.py")
+                    "predictionio_tpu/utils/wire.py",
+                    "predictionio_tpu/obs/quality.py")
 _HOT_ROUTE_FUNCS = ("frame_request", "build_response", "header",
                     "_service", "_pump",
                     # sendmsg egress + cross-wakeup batch flush
@@ -123,7 +124,9 @@ _HOT_ROUTE_FUNCS = ("frame_request", "build_response", "header",
                     "flush_hint", "_flush_pass",
                     # binary query framing (SDK fast lane)
                     "encode_bin_query", "decode_bin_query",
-                    "_decode_bin_slow")
+                    "_decode_bin_slow",
+                    # quality accumulators' serve-path entry point
+                    "observe_result")
 
 # the flight-recorder calls allowed on the hot route: stamp-slot writes
 # and deferred annotation only — anything else (materialization, ring
@@ -135,6 +138,12 @@ _HOT_TRACE_API = ("stamp", "mark", "begin_raw", "annotate",
 
 # container-name fragments the tenant-growth rule keys on
 _TENANT_NAME_FRAGMENTS = ("tenant", "lane")
+
+# files where the same rule additionally keys on app-labelled maps:
+# the quality accumulators are keyed by the serve-path app label, which
+# a key-cycling client mints at will — every map there must be
+# LRU-capped (and its writes marked '# lint: ok')
+_APP_KEYED_FILES = ("predictionio_tpu/obs/quality.py",)
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -584,7 +593,8 @@ def _check_hot_route(tree: ast.AST, text: str, rel: str) -> Iterator[str]:
                        "'# lint: ok')")
 
 
-def _tenant_named(node: ast.AST) -> str:
+def _tenant_named(node: ast.AST,
+                  fragments=_TENANT_NAME_FRAGMENTS) -> str:
     """The tenant-suggesting name behind an expression, or ''."""
     name = ""
     if isinstance(node, ast.Name):
@@ -592,7 +602,7 @@ def _tenant_named(node: ast.AST) -> str:
     elif isinstance(node, ast.Attribute):
         name = node.attr
     low = name.lower()
-    return name if any(f in low for f in _TENANT_NAME_FRAGMENTS) else ""
+    return name if any(f in low for f in fragments) else ""
 
 
 def _check_tenant_growth(tree: ast.AST, text: str,
@@ -606,9 +616,14 @@ def _check_tenant_growth(tree: ast.AST, text: str,
     ``tenancy.admission.BoundedTenantMap`` and the lane map inside
     ``tenancy.drr.DRRQueue`` (evicts idle lanes past its cap); a write
     whose bound is enforced elsewhere is marked ``# lint: ok`` on the
-    line."""
-    if not rel.startswith(_TENANCY_DIRS):
+    line. In `_APP_KEYED_FILES` (the quality accumulators) the rule
+    additionally keys on ``app``-named containers — the serve-path app
+    label is minted by remote principals too."""
+    app_keyed = rel in _APP_KEYED_FILES
+    if not (rel.startswith(_TENANCY_DIRS) or app_keyed):
         return
+    fragments = (_TENANT_NAME_FRAGMENTS + ("app",) if app_keyed
+                 else _TENANT_NAME_FRAGMENTS)
     lines = text.splitlines()
 
     def escaped(lineno: int) -> bool:
@@ -622,7 +637,7 @@ def _check_tenant_growth(tree: ast.AST, text: str,
             for t in targets:
                 if not isinstance(t, ast.Subscript):
                     continue
-                name = _tenant_named(t.value)
+                name = _tenant_named(t.value, fragments)
                 if not name or escaped(node.lineno):
                     continue
                 yield (f"{rel}:{node.lineno}: subscript-assign into "
@@ -633,7 +648,7 @@ def _check_tenant_growth(tree: ast.AST, text: str,
         elif isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "setdefault":
-            name = _tenant_named(node.func.value)
+            name = _tenant_named(node.func.value, fragments)
             if not name or escaped(node.lineno):
                 continue
             yield (f"{rel}:{node.lineno}: .setdefault() into "

@@ -1,0 +1,800 @@
+"""Prediction-quality observatory suite (obs/quality.py + serve wiring).
+
+Covers the four instruments end to end:
+
+  - `QuantileSketch` against a numpy oracle: rank error, exact
+    extremes, merge associativity (weight-exact), bounded memory
+  - the drift math (PSI / Jensen-Shannon) and `_DriftState` reference
+    binning, including the constant-reference edge case that must not
+    blow PSI up
+  - `QualityStats` on fake results: auto-freeze at _REF_MIN_N,
+    refreeze-on-reload semantics, empty/unknown ratios, the LRU app
+    cap, and the exported gauges
+  - `QualityJoiner` ticked directly against a MEM event store: exact
+    `prId` hit, attribution-window expiry (wall clock and event time),
+    unknown prIds ignored
+  - `CanaryGate` on a fake trace ring: overlap scoring, report-only
+    mode, the veto
+  - live HTTP: /quality.json shape + reference refreeze on /reload,
+    `prId`/`traceId` stamped onto posted feedback events (app-labelled
+    counters), simulated clicks joining back into a nonzero reward
+    rate, and the `pio-tpu top` quality line
+  - the fleet chaos scenario: a scrambled (inverted-ratings) model
+    rolling through /reload is canary-vetoed — roll aborted, zero
+    failed client requests — while an identical good retrain rolls
+    straight through; fleet-level /quality.json aggregation
+  - the app-keyed bounded-map lint rule and hot-route coverage of
+    `observe_result`
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import CoreWorkflow, EngineParams, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.eventserver import EventServer, EventServerConfig
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.obs import get_registry, trace
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.quality import (
+    CanaryGate, CanaryVeto, QualityJoiner, QualityStats, QuantileSketch,
+    _DriftState, js_divergence, psi,
+)
+from predictionio_tpu.serving import (
+    FleetConfig, FleetServer, PredictionServer, ServerConfig,
+)
+from predictionio_tpu.tools import lint
+
+pytestmark = pytest.mark.quality
+
+
+@pytest.fixture(autouse=True)
+def _trace_reset():
+    """Leave the process recorder back at env defaults (sampling off)
+    so foreign suites never inherit a hot recorder or a stale ring."""
+    yield
+    trace.configure(sample=0.0)
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _pred(*pairs):
+    """A fake PredictedResult: itemScores of (item, score) pairs."""
+    return SimpleNamespace(itemScores=[
+        SimpleNamespace(item=i, score=s) for i, s in pairs])
+
+
+def _seed_ratings(events, app_id, invert=False):
+    """The shared 20x15 block-structured ratings; `invert` flips the
+    preference (the scrambled model of the chaos scenario)."""
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            liked = (i % 3 == u % 3)
+            r = (1.0 if liked else 5.0) if invert \
+                else (5.0 if liked else 1.0)
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+
+
+def _train(registry, engine, app_name, seed=1):
+    ctx = RuntimeContext(registry=registry)
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name=app_name)),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4,
+                                           seed=seed)),))
+    return CoreWorkflow.run_train(engine, params, ctx)
+
+
+@pytest.fixture()
+def trained(mem_registry):
+    """Registry with a trained recommendation instance ('qualapp')."""
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "qualapp"))
+    mem_registry.get_meta_data_access_keys().insert(
+        AccessKey("QKEY", app_id, ()))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    _seed_ratings(events, app_id)
+    engine = rec.engine()
+    row = _train(mem_registry, engine, "qualapp")
+    return mem_registry, engine, row, app_id
+
+
+def start_server(registry, engine, metrics=None, **cfg):
+    config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+    srv = PredictionServer(config, registry=registry, engine=engine,
+                           metrics=metrics)
+    srv.start()
+    return srv
+
+
+# -- quantile sketch ----------------------------------------------------------
+
+class TestQuantileSketch:
+    def test_quantiles_match_numpy_oracle(self):
+        data = np.random.RandomState(7).lognormal(
+            mean=0.0, sigma=1.0, size=4000)
+        sk = QuantileSketch(k=128, rng=random.Random(0))
+        for v in data:
+            sk.update(float(v))
+        s = np.sort(data)
+        for q in (0.05, 0.25, 0.5, 0.75, 0.9, 0.99):
+            est = sk.quantile(q)
+            rank = np.searchsorted(s, est, side="right") / len(s)
+            assert abs(rank - q) < 0.05, f"q={q} rank={rank}"
+        # extremes are exact (tracked outside the compactor cascade)
+        assert sk.quantile(0.0) == s[0]
+        assert sk.quantile(1.0) == s[-1]
+        assert sk.n == 4000
+
+    def test_merge_weight_exact_and_order_insensitive(self):
+        rs = np.random.RandomState(11)
+        chunks = [rs.normal(loc=m, scale=1.0, size=2000).astype(float)
+                  for m in (0.0, 1.0, 5.0)]
+
+        def _sk(i):
+            sk = QuantileSketch(k=128, rng=random.Random(i))
+            for v in chunks[i]:
+                sk.update(v)
+            return sk
+
+        left = _sk(0).merge(_sk(1)).merge(_sk(2))
+        right = _sk(0).merge(_sk(1).merge(_sk(2)))
+        s = np.sort(np.concatenate(chunks))
+        for merged in (left, right):
+            # weight is preserved exactly, whatever the merge order
+            assert merged.n == 6000
+            assert merged.quantile(0.0) == s[0]
+            assert merged.quantile(1.0) == s[-1]
+            for q in (0.1, 0.5, 0.9):
+                rank = np.searchsorted(
+                    s, merged.quantile(q), side="right") / len(s)
+                assert abs(rank - q) < 0.06, f"q={q} rank={rank}"
+
+    def test_bounded_memory(self):
+        sk = QuantileSketch(k=64, rng=random.Random(1))
+        for i in range(50_000):
+            sk.update((i * 2654435761) % 100_003 / 100_003)
+        held = sum(len(buf) for buf in sk.levels)
+        # O(k log(n/k)): every level stays under k after compaction
+        assert all(len(buf) < 64 for buf in sk.levels)
+        assert held < 64 * len(sk.levels)
+        assert len(sk.levels) <= 14
+        assert sk.n == 50_000
+
+    def test_empty_sketch(self):
+        sk = QuantileSketch(k=16)
+        assert sk.quantile(0.5) is None
+        assert sk.cdf(1.0) == 0.0
+        assert sk.n == 0
+
+
+# -- drift math ---------------------------------------------------------------
+
+class TestDriftMath:
+    def test_psi_identity_and_shift(self):
+        assert psi([10, 10, 10], [10, 10, 10]) == pytest.approx(0.0,
+                                                                abs=1e-9)
+        assert psi([80, 15, 5], [5, 15, 80]) > 0.25
+
+    def test_js_symmetric_and_bounded(self):
+        a, b = [1.0, 0.0, 0.0], [0.0, 0.0, 1.0]
+        assert js_divergence(a, a) == pytest.approx(0.0, abs=1e-9)
+        assert js_divergence(a, b) == pytest.approx(js_divergence(b, a))
+        assert 0.9 < js_divergence(a, b) <= 1.0 + 1e-9
+
+    def test_drift_state_same_distribution_is_quiet(self):
+        rs = np.random.RandomState(3)
+        sk = QuantileSketch(k=128, rng=random.Random(0))
+        for v in rs.normal(size=2000):
+            sk.update(float(v))
+        ds = _DriftState(sk, now_min=1000)
+        assert ds.ref_n == 2000 and len(ds.edges) == 9
+        for v in rs.normal(size=400):
+            ds.observe(float(v), 1000)
+        p, j = ds.drift(1000, 5)
+        assert p < 0.15 and j < 0.1
+
+    def test_drift_state_shifted_distribution_fires(self):
+        rs = np.random.RandomState(3)
+        sk = QuantileSketch(k=128, rng=random.Random(0))
+        for v in rs.normal(size=2000):
+            sk.update(float(v))
+        ds = _DriftState(sk, now_min=1000)
+        for v in rs.normal(loc=4.0, size=400):
+            ds.observe(float(v), 1000)
+        p, j = ds.drift(1000, 5)
+        assert p > 1.0 and j > 0.3
+        # an empty window is not drift
+        assert ds.drift(1300, 5) == (0.0, 0.0)
+
+    def test_constant_reference_does_not_blow_up(self):
+        sk = QuantileSketch(k=16)
+        for _ in range(100):
+            sk.update(1.0)
+        ds = _DriftState(sk, now_min=10)
+        assert ds.edges == [1.0]          # one edge, two bins
+        for _ in range(50):
+            ds.observe(1.0, 10)
+        p, _ = ds.drift(10, 5)
+        assert p == pytest.approx(0.0, abs=0.01)
+        ds2 = _DriftState(sk, now_min=10)
+        for _ in range(50):
+            ds2.observe(2.0, 10)
+        p2, _ = ds2.drift(10, 5)
+        assert p2 > 1.0 and np.isfinite(p2)
+
+
+# -- the serve-path accumulator ----------------------------------------------
+
+class TestQualityStats:
+    def test_autofreeze_and_snapshot_shape(self):
+        qs = QualityStats(metrics=MetricsRegistry(), k=64)
+        for i in range(60):
+            qs.observe_result(
+                "a", _pred(("x", 1.0 + 0.01 * (i % 10)), ("y", 0.4)),
+                "u1", ())
+        st = qs.snapshot()["a"]
+        assert st["n"] == 60
+        # reference auto-froze at _REF_MIN_N; the live sketch restarted
+        assert st["reference"] is not None
+        assert st["reference"]["n"] == 50
+        q = st["quantiles"]["top1"]
+        assert q["n"] == 10 and 1.0 <= q["p50"] <= 1.1
+        assert q["min"] <= q["p50"] <= q["p90"] <= q["p99"] <= q["max"]
+        assert "margin" in st["quantiles"]
+        assert "top1_psi" in st["windows"]["5m"]
+        assert "margin_js" in st["windows"]["1h"]
+
+    def test_refreeze_moves_the_reference(self):
+        qs = QualityStats(metrics=MetricsRegistry(), k=64)
+        # phase A: 50 obs -> auto-freeze (reference A)
+        for i in range(50):
+            qs.observe_result("a", _pred(("x", 0.01 * i)), None, ())
+        ref1 = qs.snapshot()["a"]["reference"]
+        assert ref1 is not None and ref1["n"] == 50
+        # phase B: same distribution again -> drift stays quiet
+        for i in range(50):
+            qs.observe_result("a", _pred(("x", 0.01 * i)), None, ())
+        assert qs.snapshot()["a"]["windows"]["5m"]["top1_psi"] < 0.2
+        # a successful reload refreezes: phase B becomes the reference
+        qs.freeze_reference()
+        ref2 = qs.snapshot()["a"]["reference"]
+        assert ref2["n"] == 50 and ref2["frozen_at"] >= ref1["frozen_at"]
+        # phase C: shifted scores -> drift fires against the new ref
+        for i in range(50):
+            qs.observe_result("a", _pred(("x", 5.0 + 0.01 * i)),
+                              None, ())
+        w = qs.snapshot()["a"]["windows"]["5m"]
+        assert w["top1_psi"] > 1.0 and w["top1_js"] > 0.3
+
+    def test_empty_and_unknown_ratios(self):
+        qs = QualityStats(metrics=MetricsRegistry(), k=32)
+        maps = ({"u1": 0},)
+        qs.observe_result("b", _pred(), "ghost", maps)
+        qs.observe_result("b", _pred(("x", 1.0)), "u1", maps)
+        st = qs.snapshot()["b"]
+        assert st["empty_total"] == 1 and st["unknown_total"] == 1
+        w = st["windows"]["5m"]
+        assert w["empty_ratio"] == pytest.approx(0.5)
+        assert w["unknown_ratio"] == pytest.approx(0.5)
+
+    def test_lru_caps_the_app_map(self):
+        qs = QualityStats(metrics=MetricsRegistry(), max_apps=2, k=32)
+        for app in ("a", "b", "c"):
+            qs.observe_result(app, _pred(("x", 1.0)), None, ())
+        snap = qs.snapshot()
+        assert set(snap) == {"b", "c"}     # oldest evicted
+
+    def test_gauges_exported(self):
+        reg = MetricsRegistry()
+        qs = QualityStats(metrics=reg, k=64)
+        for i in range(50):
+            qs.observe_result("a", _pred(("x", 0.01 * i), ("y", -1.0)),
+                              None, ())
+        for _ in range(20):
+            qs.observe_result("a", _pred(("x", 9.0), ("y", -1.0)),
+                              None, ())
+        qs.observe_result("a", _pred(), None, ())
+        qs._sync_gauges(time.time() + 10.0, int(time.time() // 60.0))
+        assert reg.value("pio_pred_drift", app="a", metric="top1_psi",
+                         window="5m") > 0.5
+        assert reg.value("pio_pred_ratio", app="a", kind="empty",
+                         window="5m") > 0.0
+
+
+# -- feedback join ------------------------------------------------------------
+
+def _fake_server(mem_registry, app_name="joinapp"):
+    """The minimum deployment surface `locate_event_store` needs."""
+    app_id = mem_registry.get_meta_data_apps().insert(App(0, app_name))
+    mem_registry.get_events().init(app_id)
+    dep = SimpleNamespace(instance=SimpleNamespace(
+        data_source_params=json.dumps(
+            {"name": "", "params": {"app_name": app_name}})))
+    srv = SimpleNamespace(_dep=dep,
+                          ctx=RuntimeContext(registry=mem_registry))
+    return srv, app_id
+
+
+class TestQualityJoiner:
+    def test_exact_prid_join(self, mem_registry):
+        srv, app_id = _fake_server(mem_registry)
+        reg = MetricsRegistry()
+        j = QualityJoiner(srv, attribution_s=30.0, metrics=reg)
+        assert j.tick() == "baseline"
+        events = mem_registry.get_events()
+        events.insert(Event(event="predict", entity_type="pio_pr",
+                            entity_id="PR1"), app_id)
+        assert j.tick() == "scanned"
+        assert j.snapshot()["pending"] == 1
+        events.insert(Event(event="click", entity_type="user",
+                            entity_id="u1",
+                            properties=DataMap({"prId": "PR1"})), app_id)
+        assert j.tick() == "scanned"
+        snap = j.snapshot()
+        assert snap["pending"] == 0
+        assert snap["apps"]["joinapp"]["joined_total"] == 1
+        assert snap["apps"]["joinapp"]["reward_rate"] == 1.0
+        assert reg.value("pio_feedback_join_total", app="joinapp",
+                         outcome="joined") == 1
+        assert reg.value("pio_pred_reward_rate", app="joinapp") == 1.0
+
+    def test_wallclock_expiry_counts_unjoined(self, mem_registry):
+        srv, app_id = _fake_server(mem_registry)
+        reg = MetricsRegistry()
+        j = QualityJoiner(srv, attribution_s=0.05, metrics=reg)
+        j.tick()
+        mem_registry.get_events().insert(
+            Event(event="predict", entity_type="pio_pr",
+                  entity_id="PR2"), app_id)
+        assert j.tick() == "scanned"
+        time.sleep(0.12)
+        j.tick()
+        snap = j.snapshot()
+        assert snap["pending"] == 0
+        assert snap["apps"]["joinapp"]["unjoined_total"] == 1
+        assert snap["apps"]["joinapp"]["unjoined_ratio"] == 1.0
+        assert reg.value("pio_feedback_join_total", app="joinapp",
+                         outcome="expired") == 1
+
+    def test_event_time_outside_window_expires(self, mem_registry):
+        srv, app_id = _fake_server(mem_registry)
+        reg = MetricsRegistry()
+        j = QualityJoiner(srv, attribution_s=30.0, metrics=reg)
+        j.tick()
+        now = datetime.now(timezone.utc)
+        events = mem_registry.get_events()
+        events.insert(Event(event="predict", entity_type="pio_pr",
+                            entity_id="PR3", event_time=now), app_id)
+        events.insert(Event(event="click", entity_type="user",
+                            entity_id="u1",
+                            properties=DataMap({"prId": "PR3"}),
+                            event_time=now + timedelta(seconds=60)),
+                      app_id)
+        assert j.tick() == "scanned"
+        assert reg.value("pio_feedback_join_total", app="joinapp",
+                         outcome="expired") == 1
+        assert reg.value("pio_feedback_join_total", app="joinapp",
+                         outcome="joined") == 0
+
+    def test_unknown_prid_ignored(self, mem_registry):
+        srv, app_id = _fake_server(mem_registry)
+        j = QualityJoiner(srv, attribution_s=30.0,
+                          metrics=MetricsRegistry())
+        j.tick()
+        mem_registry.get_events().insert(
+            Event(event="click", entity_type="user", entity_id="u1",
+                  properties=DataMap({"prId": "GHOST"})), app_id)
+        j.tick()
+        snap = j.snapshot()
+        assert snap["pending"] == 0 and snap["apps"] == {}
+
+    def test_outcomes_without_deployment(self, mem_registry):
+        srv = SimpleNamespace(_dep=None,
+                              ctx=RuntimeContext(registry=mem_registry))
+        j = QualityJoiner(srv, metrics=MetricsRegistry())
+        assert j.tick() == "no_deployment"
+        srv._dep = SimpleNamespace(instance=SimpleNamespace(
+            data_source_params="{}"))
+        assert j.tick() == "no_app"
+
+
+# -- canary gate (unit) -------------------------------------------------------
+
+class _FakeRecorder:
+    def __init__(self, entries):
+        self._entries = entries
+
+    def snapshot(self):
+        return self._entries
+
+
+def _serve_entries(n, app="a"):
+    return [{"kind": "serve", "app": app,
+             "query": {"user": f"u{i}", "num": 2}} for i in range(n)]
+
+
+class TestCanaryGate:
+    def test_identical_plans_pass(self, monkeypatch):
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.trace.get_recorder",
+            lambda: _FakeRecorder(_serve_entries(4)))
+        reg = MetricsRegistry()
+        gate = CanaryGate(sample=8, min_overlap=0.5, metrics=reg)
+
+        def replay(dep, qdicts):
+            return [_pred(("i0", 1.0), ("i1", 0.5)) for _ in qdicts]
+
+        report = gate.check("old", "new", replay)
+        assert report["outcome"] == "pass"
+        assert report["overlap"] == 1.0 and report["sampled"] == 4
+        assert report["score_delta"] == 0.0
+        assert report["per_app"]["a"] == 1.0
+        assert gate.last is report
+        assert reg.value("pio_canary_total", outcome="pass") == 1
+        assert reg.value("pio_canary_overlap", app="a") == 1.0
+
+    def test_disjoint_plans_vetoed(self, monkeypatch):
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.trace.get_recorder",
+            lambda: _FakeRecorder(_serve_entries(4)))
+        reg = MetricsRegistry()
+        gate = CanaryGate(sample=8, min_overlap=0.5, metrics=reg)
+
+        def replay(dep, qdicts):
+            ids = ("i0", "i1") if dep == "old" else ("z0", "z1")
+            return [_pred((ids[0], 1.0), (ids[1], 0.5)) for _ in qdicts]
+
+        with pytest.raises(CanaryVeto, match="overlap 0.000"):
+            gate.check("old", "new", replay)
+        assert gate.last["outcome"] == "fail"
+        assert reg.value("pio_canary_total", outcome="fail") == 1
+
+    def test_report_only_when_threshold_unset(self, monkeypatch):
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.trace.get_recorder",
+            lambda: _FakeRecorder(_serve_entries(2)))
+        gate = CanaryGate(sample=8, min_overlap=0.0,
+                          metrics=MetricsRegistry())
+
+        def replay(dep, qdicts):
+            ids = ("i0",) if dep == "old" else ("z0",)
+            return [_pred((ids[0], 1.0)) for _ in qdicts]
+
+        report = gate.check("old", "new", replay)
+        assert report["outcome"] == "pass" and report["overlap"] == 0.0
+
+    def test_empty_results_agree_and_empty_ring_skips(self, monkeypatch):
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.trace.get_recorder",
+            lambda: _FakeRecorder(_serve_entries(2)))
+        gate = CanaryGate(sample=8, min_overlap=0.9,
+                          metrics=MetricsRegistry())
+
+        def replay(dep, qdicts):
+            return [_pred() for _ in qdicts]
+
+        assert gate.check("old", "new", replay)["overlap"] == 1.0
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.trace.get_recorder",
+            lambda: _FakeRecorder([]))
+        reg = MetricsRegistry()
+        gate2 = CanaryGate(sample=8, min_overlap=0.9, metrics=reg)
+        assert gate2.check("old", "new", replay) is None
+        assert reg.value("pio_canary_total", outcome="skipped") == 1
+
+
+# -- live HTTP ----------------------------------------------------------------
+
+class TestLiveQuality:
+    def test_quality_json_and_reload_refreeze(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, metrics=MetricsRegistry())
+        try:
+            for i in range(60):
+                status, _ = call(srv.port, "POST", "/queries.json",
+                                 {"user": f"u{i % 20}", "num": 3})
+                assert status == 200
+            status, _ = call(srv.port, "POST", "/queries.json",
+                             {"user": "ghost", "num": 3})
+            assert status == 200            # empty + unknown entity
+            status, body = call(srv.port, "GET", "/quality.json")
+            assert status == 200 and body["enabled"] is True
+            st = body["apps"][""]
+            assert st["n"] == 61
+            assert st["empty_total"] >= 1 and st["unknown_total"] >= 1
+            assert st["quantiles"]["top1"]["n"] >= 1
+            ref1 = st["reference"]
+            assert ref1 is not None and ref1["n"] == 50
+            assert "top1_psi" in st["windows"]["5m"]
+            # a successful /reload refreezes the reference window
+            status, _ = call(srv.port, "POST", "/reload")
+            assert status == 200
+            status, body = call(srv.port, "GET", "/quality.json")
+            st = body["apps"][""]
+            # the ghost query carries no top-1 score, so the refrozen
+            # reference holds exactly the 10 post-autofreeze scores
+            assert st["reference"]["n"] == 10
+            assert st["reference"]["frozen_at"] >= ref1["frozen_at"]
+            # the `pio-tpu top` quality line reads the same endpoint
+            from predictionio_tpu.tools.admin import (
+                _quality_line, top_view,
+            )
+            line = _quality_line("127.0.0.1", srv.port)
+            assert line is not None and "drift(psi)" in line
+            assert "drift(psi)" in top_view("127.0.0.1", srv.port)
+        finally:
+            srv.shutdown()
+
+    def test_quality_off_disables_endpoint(self, trained):
+        registry, engine, _, _ = trained
+        srv = start_server(registry, engine, quality=False,
+                           metrics=MetricsRegistry())
+        try:
+            call(srv.port, "POST", "/queries.json",
+                 {"user": "u1", "num": 2})
+            status, body = call(srv.port, "GET", "/quality.json")
+            assert status == 200
+            assert body["enabled"] is False and body["apps"] == {}
+            assert "joiner" not in body and "canary" not in body
+        finally:
+            srv.shutdown()
+
+    def test_feedback_carries_prid_and_clicks_become_reward(
+            self, trained):
+        registry, engine, _, app_id = trained
+        trace.configure(sample=1.0, ring=64)
+        es = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                         registry)
+        es.start()
+        metrics = MetricsRegistry()
+        srv = start_server(
+            registry, engine, metrics=metrics, feedback=True,
+            event_server_ip="127.0.0.1", event_server_port=es.port,
+            access_key="QKEY", attribution_s=60.0)
+        try:
+            for i in range(3):
+                status, _ = call(srv.port, "POST", "/queries.json",
+                                 {"user": f"u{i}", "num": 2})
+                assert status == 200
+            deadline = time.time() + 5
+            found = []
+            while len(found) < 3 and time.time() < deadline:
+                found = list(registry.get_events().find(
+                    app_id, event_names=["predict"]))
+                time.sleep(0.05)
+            assert len(found) >= 3, "feedback predict events missing"
+            for ev in found:
+                assert ev.entity_type == "pio_pr"
+                # satellite: prId + trace id stamped onto the event
+                assert ev.properties.get("prId") == ev.entity_id
+                assert ev.properties.get("traceId")
+            # app-labelled send counter (label "" = tenancy off)
+            deadline = time.time() + 5
+            while metrics.value("pio_feedback_events_total",
+                                outcome="sent", app="") < 3 \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert metrics.value("pio_feedback_events_total",
+                                 outcome="sent", app="") >= 3
+            # simulated clicks -> the joiner turns them into reward
+            for ev in found:
+                registry.get_events().insert(
+                    Event(event="click", entity_type="user",
+                          entity_id="u1",
+                          properties=DataMap(
+                              {"prId": ev.entity_id})), app_id)
+            deadline = time.time() + 10
+            reward = 0.0
+            while reward == 0.0 and time.time() < deadline:
+                status, body = call(srv.port, "GET", "/quality.json")
+                assert status == 200
+                japps = (body.get("joiner") or {}).get("apps") or {}
+                reward = japps.get("qualapp", {}).get("reward_rate", 0.0)
+                time.sleep(0.1)
+            assert reward > 0.0, "clicks never joined into reward"
+            body_j = body["joiner"]
+            assert body_j["attribution_s"] == 60.0
+            assert body_j["apps"]["qualapp"]["joined_total"] >= 1
+        finally:
+            srv.shutdown()
+            es.shutdown()
+
+
+# -- fleet: canary-gated rolling reload ---------------------------------------
+
+def _start_fleet(trained, replicas=2, **fleet_kw):
+    registry, engine, _, _ = trained
+    fleet_kw.setdefault("health_interval_s", 0.1)
+    fleet_kw.setdefault("eject_threshold", 2)
+    fleet_kw.setdefault("drain_timeout_s", 2.0)
+    srv = FleetServer(ServerConfig(ip="127.0.0.1", port=0),
+                      FleetConfig(replicas=replicas, **fleet_kw),
+                      registry=registry, engine=engine)
+    srv.start()
+    return srv
+
+
+class _Loader:
+    """Client hammer; records every response status."""
+
+    def __init__(self, port, threads=2):
+        self.port = port
+        self.halt = threading.Event()
+        self.statuses = []
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(threads)]
+
+    def _run(self):
+        while not self.halt.is_set():
+            try:
+                status, _ = call(self.port, "POST", "/queries.json",
+                                 {"user": "u1", "num": 2})
+            except OSError:
+                status = -1
+            with self._lock:
+                self.statuses.append(status)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    @property
+    def failures(self):
+        with self._lock:
+            return [s for s in self.statuses if s != 200]
+
+
+class TestFleetCanary:
+    def test_scrambled_roll_vetoed_good_roll_passes(
+            self, trained, monkeypatch):
+        """The ISSUE chaos scenario: a model trained on INVERTED
+        ratings reaches 'latest completed'; the canary replays traced
+        queries old-vs-new, sees the top-k flip, and aborts the roll
+        through the load-failed path — zero failed client requests.
+        An identical good retrain then rolls straight through."""
+        registry, engine, row1, app_id = trained
+        monkeypatch.setenv("PIO_CANARY_SAMPLE", "8")
+        # 0.7 sits between the scrambled model's overlap (<= 0.5 on
+        # every replayed query: inverted preferences flip the top-k)
+        # and the good retrain's exact 1.0 (identical params + seed)
+        monkeypatch.setenv("PIO_CANARY_MIN_OVERLAP", "0.7")
+        trace.configure(sample=1.0, ring=256)
+        fleet = _start_fleet(trained, replicas=2)
+        try:
+            # traffic -> kept serve traces carrying replayable queries
+            for i in range(10):
+                status, _ = call(fleet.port, "POST", "/queries.json",
+                                 {"user": f"u{i % 5}", "num": 3})
+                assert status == 200
+            # the scrambled candidate: same users/items, preference
+            # inverted -> its top-k disagrees with the serving model
+            sid = registry.get_meta_data_apps().insert(
+                App(0, "scrambledapp"))
+            registry.get_events().init(sid)
+            _seed_ratings(registry.get_events(), sid, invert=True)
+            row2 = _train(registry, engine, "scrambledapp")
+            assert row2.id != row1.id
+            fail_before = get_registry().value("pio_canary_total",
+                                               outcome="fail")
+            with _Loader(fleet.port) as load:
+                status, report = call(fleet.port, "POST", "/reload")
+            assert status == 500 and report["aborted"] is True, report
+            assert len(report["results"]) == 1
+            r0 = report["results"][0]
+            assert r0["outcome"] == "load_failed_rolled_back"
+            assert "canary overlap" in r0["detail"]
+            # ZERO failed client requests through the vetoed roll
+            assert len(load.statuses) > 0 and load.failures == []
+            assert get_registry().value(
+                "pio_canary_total", outcome="fail") > fail_before
+            # every replica still serves the old model
+            for rep in fleet._replicas:
+                s, b = call(rep.port, "GET", "/status.json")
+                assert s == 200 and b["engineInstanceId"] == row1.id
+            # a good candidate (identical retrain) passes the gate
+            row3 = _train(registry, engine, "qualapp", seed=1)
+            with _Loader(fleet.port) as load2:
+                status, report = call(fleet.port, "POST", "/reload")
+            assert status == 200 and report["aborted"] is False
+            assert [r["outcome"] for r in report["results"]] \
+                == ["reloaded"] * 2
+            assert len(load2.statuses) > 0 and load2.failures == []
+            for rep in fleet._replicas:
+                s, b = call(rep.port, "GET", "/status.json")
+                assert s == 200 and b["engineInstanceId"] == row3.id
+            # fleet-level /quality.json aggregates the members
+            status, body = call(fleet.port, "GET", "/quality.json")
+            assert status == 200 and body["role"] == "fleet"
+            assert len(body["members"]) == 2
+            assert any(m.get("enabled")
+                       for m in body["members"].values())
+        finally:
+            fleet.stop()
+
+
+# -- lint rules ---------------------------------------------------------------
+
+def _fake_tree(tmp_path, rel, src):
+    f = tmp_path.joinpath(*rel.split("/"))
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(src)
+
+
+class TestLintRules:
+    def test_app_keyed_growth_flagged_in_quality(self, tmp_path):
+        _fake_tree(
+            tmp_path, "predictionio_tpu/obs/quality.py",
+            '"""doc"""\n\n\n'
+            "class Q:\n"
+            "    def note(self, app, st):\n"
+            "        self._apps[app] = st\n")
+        out = "\n".join(lint.run(tmp_path))
+        assert "tenant-keyed" in out and "_apps" in out
+
+    def test_app_keyed_escape_hatch(self, tmp_path):
+        _fake_tree(
+            tmp_path, "predictionio_tpu/obs/quality.py",
+            '"""doc"""\n\n\n'
+            "class Q:\n"
+            "    def note(self, app, st):\n"
+            "        self._apps[app] = st    # lint: ok (capped)\n")
+        assert not lint.run(tmp_path)
+
+    def test_app_fragment_scoped_to_quality_files(self, tmp_path):
+        # the same write elsewhere in obs/ is NOT app-keyed state
+        _fake_tree(
+            tmp_path, "predictionio_tpu/obs/other.py",
+            '"""doc"""\n\n\n'
+            "class Q:\n"
+            "    def note(self, app, st):\n"
+            "        self._apps[app] = st\n")
+        assert not lint.run(tmp_path)
+
+    def test_hot_route_rule_covers_observe_result(self, tmp_path):
+        _fake_tree(
+            tmp_path, "predictionio_tpu/obs/quality.py",
+            '"""doc"""\n\n\n'
+            "class Q:\n"
+            "    def observe_result(self, app, result):\n"
+            "        d = {\"app\": app}  # noqa\n"
+            "        return d\n")
+        out = "\n".join(lint.run(tmp_path))
+        assert "dict literal" in out and "observe_result" in out
