@@ -1357,6 +1357,105 @@ def bench_quality(u, i, r, n_users, n_items):
             f"({per_call_s * 1e6:.2f}us/call at {base_qps:.0f} qps)")
 
 
+def bench_watchdog(u, i, r, n_users, n_items):
+    """Self-healing gates: (1) the keep-alive hammer with the watchdog
+    sweeper stopped (baseline) vs sweeping at the production 1 Hz
+    cadence (each sweep exports every beat age and runs the pressure
+    guard's RSS read), interleaved best-of-N, gate <= 0.5% qps
+    overhead; (2) the supervised replica-kill scenario:
+    SIGKILL one replica under open-loop load, it must respawn,
+    re-register, and recover in < 5 s with zero failed requests."""
+    import http.client as _hc
+
+    from predictionio_tpu.resilience import scenarios
+    from predictionio_tpu.resilience.watchdog import watchdog
+
+    server, _registry, _engine = _deploy_server(u, i, r, n_users, n_items)
+    payloads = [json.dumps({"user": f"u{q % n_users}", "num": 10}).encode()
+                for q in range(256)]
+    n_threads, per_thread = 8, 150
+
+    def _hammer():
+        conns = {}
+
+        def req(i):
+            tid = i // per_thread
+            c = conns.get(tid)
+            if c is None:
+                c = _hc.HTTPConnection("127.0.0.1", server.port,
+                                       timeout=30)
+                conns[tid] = c
+            c.request("POST", "/queries.json",
+                      body=payloads[i % len(payloads)],
+                      headers={"Content-Type": "application/json"})
+            resp = c.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"status {resp.status}")
+
+        dt = _fanout(req, n_threads, per_thread)
+        for c in conns.values():
+            c.close()
+        return n_threads * per_thread / dt
+
+    wd = watchdog()
+    saved_interval = wd.interval_s
+
+    def _enter_off():
+        wd.stop()
+
+    def _enter_on():
+        wd.interval_s = 1.0          # the production default cadence
+        wd.ensure_started()
+
+    modes = {"off": _enter_off, "on": _enter_on}
+    best = {m: 0.0 for m in modes}
+    try:
+        for q in range(20):
+            _post(server.port, {"user": f"u{q}", "num": 10})   # warm
+        # same convergence budget as the obs bench's 0.5% gate
+        for _ in range(12):
+            for mode, enter in modes.items():
+                enter()
+                best[mode] = max(best[mode], _hammer())
+    finally:
+        wd.interval_s = saved_interval
+        wd.ensure_started()
+        server.shutdown()
+
+    base_qps = best["off"]
+    emit("watchdog_baseline_qps", base_qps, "qps", 1.0)
+    emit("watchdog_on_qps", best["on"], "qps",
+         best["on"] / max(base_qps, 1e-9))
+    overhead = max(base_qps / max(best["on"], 1e-9) - 1.0, 0.0)
+    budget = 0.005
+    emit("watchdog_overhead", overhead * 100.0, "pct",
+         1.0 if overhead <= budget else budget / overhead)
+    if overhead > budget:
+        raise SystemExit(
+            f"watchdog: sweeper overhead {overhead * 100.0:.2f}% > "
+            f"{budget * 100.0:.1f}% gate (baseline {base_qps:.0f} qps, "
+            f"on {best['on']:.0f} qps)")
+
+    # (2) kill-respawn recovery: the declarative chaos scenario IS the
+    # measured workload — open-loop load, SIGKILL, respawn, re-admit
+    report = scenarios.run("replica-kill",
+                           trained=scenarios.train_tiny())
+    if not report.ok:
+        raise SystemExit("watchdog: replica-kill scenario failed: "
+                         + "; ".join(report.violations))
+    recovery_s = float(report.notes.get("recovery_s", -1.0))
+    emit("watchdog_replica_kill_requests", float(report.requests),
+         "requests", 1.0)
+    emit("watchdog_replica_recovery_s", recovery_s, "s",
+         1.0 if 0.0 <= recovery_s < 5.0 else 5.0 / max(recovery_s, 5.0))
+    if not 0.0 <= recovery_s < 5.0:
+        raise SystemExit(
+            f"watchdog: replica kill-respawn recovery {recovery_s:.2f}s "
+            f">= 5s gate ({report.requests} requests, "
+            f"{report.failures} failed)")
+
+
 def bench_serving(u, i, r, n_users, n_items):
     from predictionio_tpu.serving import PredictionServer, ServerConfig
 
@@ -3412,6 +3511,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_quality, u, i, r, n_users, n_items)
         return
+    if "--only-watchdog" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_watchdog, u, i, r, n_users, n_items)
+        return
     if "--only-serving" in sys.argv:
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_serving, u, i, r, n_users, n_items)
@@ -3445,6 +3548,7 @@ def main():
         section(bench_wire, u, i, r, n_users, n_items)
         section(bench_obs, u, i, r, n_users, n_items)
         section(bench_quality, u, i, r, n_users, n_items)
+        section(bench_watchdog, u, i, r, n_users, n_items)
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)

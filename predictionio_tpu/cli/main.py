@@ -11,7 +11,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import signal
 import sys
 import time
 from typing import Optional
@@ -137,6 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "as a standalone fleet replica that registers "
                         "with (and heartbeats) every listed router, e.g. "
                         "--join http://router:8000,http://standby:8000")
+    x.add_argument("--supervised", type=int, default=0, metavar="N",
+                   help="run N replicas as supervised CHILD PROCESSES "
+                        "behind a router-only control plane: a replica "
+                        "that crashes or is SIGKILLed is respawned with "
+                        "jittered backoff (crash loops circuit-break), "
+                        "re-registers through the membership path, and "
+                        "SIGTERM gives every child a graceful drain")
     x.add_argument("--advertise",
                    help="host:port other fleet hosts reach this process "
                         "at (default 127.0.0.1:<port>; required for "
@@ -258,6 +264,20 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("daemon_argv", nargs=argparse.REMAINDER,
                    help="subcommand to run, e.g. -- eventserver --port 7070")
 
+    # chaos ----------------------------------------------------------------
+    x = sub.add_parser(
+        "chaos",
+        help="self-healing drills: timed fault scenarios (thread "
+             "stall/death, lease failover, memory pressure, replica "
+             "SIGKILL) against a real loopback topology, gated on "
+             "invariants — non-zero exit on any violation")
+    chaos = x.add_subparsers(dest="chaos_command", required=True)
+    chaos.add_parser("list", help="list scenarios")
+    y = chaos.add_parser("run", help="run one scenario (or 'all')")
+    y.add_argument("scenario", help="scenario name, or 'all'")
+    y.add_argument("--json", action="store_true",
+                   help="machine-readable reports on stdout")
+
     # misc -----------------------------------------------------------------
     x = sub.add_parser(
         "doctor",
@@ -299,15 +319,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _serve_forever(server) -> None:   # pragma: no cover - signal loop
-    stop = {"flag": False}
+    # SIGTERM/SIGINT route through the server's graceful stop() drain
+    # (accepted requests finish, replicas deregister) — the supervisor
+    # and `kill` both get a clean exit instead of a mid-request death
+    from predictionio_tpu.serving.server import install_signal_handlers
+    done = {"flag": False}
 
-    def handler(signum, frame):
-        stop["flag"] = True
+    def _on_stopped():
+        done["flag"] = True
 
-    signal.signal(signal.SIGINT, handler)
-    signal.signal(signal.SIGTERM, handler)
+    install_signal_handlers(server, on_stopped=_on_stopped)
     try:
-        while not stop["flag"] and server.is_running():
+        while not done["flag"] and server.is_running():
             time.sleep(0.2)
     finally:
         if server.is_running():
@@ -393,6 +416,36 @@ def main(argv: Optional[list] = None) -> int:
                 attribution_s=args.attribution_s,
                 canary_sample=args.canary_sample,
                 canary_min_overlap=args.canary_min_overlap)
+            if args.supervised > 0 and not args.join:
+                # router-only control plane + N supervised replica child
+                # processes: each child re-runs this CLI with the same
+                # deploy flags, minus supervision/port, plus --join back
+                # here on an ephemeral port
+                from predictionio_tpu.serving.supervisor import (
+                    ChildSpec, Supervisor, child_argv_from_parent,
+                )
+                server = FleetServer(
+                    config, fleet_config_from_env(
+                        registry.config, replicas=0,
+                        advertise=args.advertise or ""),
+                    registry=registry)
+                port = server.start()
+                parent_argv = list(argv) if argv is not None \
+                    else sys.argv[1:]
+                child_argv = child_argv_from_parent(
+                    parent_argv, f"http://127.0.0.1:{port}")
+                sup = Supervisor(
+                    [ChildSpec(f"replica{i}", list(child_argv))
+                     for i in range(args.supervised)])
+                sup.start()
+                print(f"Fleet control plane started on {args.ip}:{port} "
+                      f"({args.supervised} supervised replica "
+                      f"processes)", flush=True)
+                try:
+                    _serve_forever(server)
+                finally:
+                    sup.stop()
+                return 0
             if args.join:
                 # standalone replica: serve locally, register with (and
                 # heartbeat) every router listed. The joined routers are
@@ -494,6 +547,34 @@ def main(argv: Optional[list] = None) -> int:
         if cmd == "status":
             _emit(ops.status(_registry()))
             return 0
+        if cmd == "chaos":
+            from predictionio_tpu.resilience import scenarios
+            if args.chaos_command == "list":
+                _emit([{"name": n,
+                        "description": scenarios.get(n).description}
+                       for n in scenarios.names()])
+                return 0
+            wanted = (scenarios.names() if args.scenario == "all"
+                      else [args.scenario])
+            unknown = [n for n in wanted if n not in scenarios.names()]
+            if unknown:
+                print(f"[ERROR] unknown scenario(s): "
+                      f"{', '.join(unknown)}; have: "
+                      f"{', '.join(scenarios.names())}", file=sys.stderr)
+                return 2
+            trained = scenarios.train_tiny()
+            rc = 0
+            reports = []
+            for n in wanted:
+                report = scenarios.run(n, trained=trained)
+                reports.append(report.to_json())
+                if not report.ok:
+                    rc = 1
+                if not args.json:
+                    print(scenarios.format_report(report), flush=True)
+            if args.json:
+                _emit(reports)
+            return rc
         if cmd == "doctor":
             report = ops.doctor(_registry(), repair=args.repair,
                                 stale_after_s=args.stale_after)

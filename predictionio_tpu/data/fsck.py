@@ -278,16 +278,30 @@ class ScheduledFsck:
         self.retention_s = retention_s
         self.log = log
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._loop, name="pio-fsck-sched", daemon=True)
+        self._thread = None
+        self.beat = None                # watchdog liveness stamp
 
     def start(self) -> "ScheduledFsck":
-        self._thread.start()
+        if self.beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            self.beat = watchdog().register(
+                "fsck", budget_s=self.interval_s * 3.0 + 10.0,
+                restart=self._spawn)
+        self._spawn()
         return self
+
+    def _spawn(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="pio-fsck-sched", daemon=True)
+        self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5.0)
+        beat, self.beat = self.beat, None
+        if beat is not None:
+            beat.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
     def run_once(self) -> Dict[str, List[dict]]:
         """One tick, callable synchronously (tests, forced sweeps)."""
@@ -299,7 +313,17 @@ class ScheduledFsck:
         return report
 
     def _loop(self) -> None:
+        beat = self.beat
+        if beat is not None:
+            beat.guard(self._loop_body)
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
+        beat = self.beat
         while not self._stop.wait(self.interval_s):
+            if beat is not None:
+                beat.tick()
             try:
                 self.run_once()
             except Exception as exc:

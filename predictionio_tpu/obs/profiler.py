@@ -61,8 +61,11 @@ _ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("pio-replica-agent", "heartbeat"),
     ("pio-heartbeat-", "heartbeat"),
     ("pio-fsck-sched", "heartbeat"),
+    ("pio-quality-join", "joiner"),
     ("pio-prof", "obs"),
     ("pio-tsdb", "obs"),
+    ("pio-watchdog", "obs"),
+    ("pio-supervisor", "supervisor"),
     ("pio-http-serve", "http"),
     ("MainThread", "main"),
 )
@@ -76,6 +79,24 @@ def role_of(thread_name: str) -> str:
         if thread_name.startswith(prefix):
             return role
     return "other"
+
+
+def format_thread_stack(ident: int, limit: int = 40) -> str:
+    """One thread's current stack as a compact one-line string
+    (`mod:func:line < mod:func:line < ...`, innermost first) from the
+    same `sys._current_frames()` walk the sampler folds — the
+    watchdog's stall dump. Empty string when the thread is gone."""
+    frame = sys._current_frames().get(ident)
+    if frame is None:
+        return ""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < limit:
+        code = f.f_code
+        mod = code.co_filename.rsplit("/", 1)[-1]
+        parts.append(f"{mod}:{code.co_name}:{f.f_lineno}")
+        f = f.f_back
+    return " < ".join(parts)
 
 
 def _envf(name: str, default: float) -> float:

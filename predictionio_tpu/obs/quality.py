@@ -564,6 +564,21 @@ class QualityStats:
             for st in self._apps.values():
                 st.freeze(now_min)
 
+    def trim(self) -> int:
+        """Soft-memory-pressure hook: drop every per-app accumulator
+        (sketches, drift references, minute rings) and the pending
+        observation buffer; they rebuild from live traffic. Returns
+        the approximate bytes released."""
+        with self._lock:
+            freed = len(self._buf) * 96
+            # sketches + rings + drift refs per app: coarse estimate
+            freed += len(self._apps) * (self._k * 2 * 8 + _N_BUCKETS * 72)
+            self._buf = []
+            self._apps.clear()
+            self._last_app = None
+            self._last_st = None
+        return freed
+
     def snapshot(self) -> Dict:
         """The `/quality.json` app section."""
         now = time.time()
@@ -695,6 +710,7 @@ class QualityJoiner:
         self._wm = None
         self._lock = threading.Lock()
         self.last_outcome = ""          # test/introspection surface
+        self.beat = None                # watchdog liveness stamp
         reg = metrics if metrics is not None else get_registry()
         self._c_join = reg.counter(
             "pio_feedback_join_total",
@@ -714,18 +730,39 @@ class QualityJoiner:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
+        if self.beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            self.beat = watchdog().register(
+                "joiner", budget_s=self.interval_s * 3.0 + 5.0,
+                restart=self._spawn)
+        self._spawn()
+
+    def _spawn(self) -> None:
         self._thread = threading.Thread(
             target=self._loop, name="pio-quality-join", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        beat, self.beat = self.beat, None
+        if beat is not None:
+            beat.close()
         t = self._thread
         if t is not None:
             t.join(min(10.0, self.interval_s + 5.0))
 
     def _loop(self) -> None:
+        beat = self.beat
+        if beat is not None:
+            beat.guard(self._loop_body)
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
+        beat = self.beat
         while not self._stop.is_set():
+            if beat is not None:
+                beat.tick()
             try:
                 self.tick()
             except Exception:

@@ -394,6 +394,18 @@ class TraceRecorder:
         with self._lock:
             return len(self._ring)
 
+    def trim(self, keep_frac: float = 0.5) -> int:
+        """Soft-memory-pressure hook: drop the oldest trace entries
+        down to `keep_frac` of the current ring; returns approximate
+        bytes released (entries are small dicts of spans/stamps)."""
+        dropped = 0
+        with self._lock:
+            keep = max(1, int(len(self._ring) * keep_frac))
+            while len(self._ring) > keep:
+                self._ring.popleft()
+                dropped += 1
+        return dropped * 512     # span-list dict estimate
+
 
 # -- process-global recorder + module-level stamp API ------------------------
 # The functions below are the ONLY trace calls the hot-route lint

@@ -200,6 +200,20 @@ class TSDB:
         with self._lock:
             return list(self._series)
 
+    def trim(self, keep_frac: float = 0.5) -> int:
+        """Soft-memory-pressure hook: drop the oldest points of every
+        ring down to `keep_frac` of their current length (recent
+        history is what operators debug with). Returns the approximate
+        bytes released."""
+        dropped = 0
+        with self._lock:
+            for s in self._series.values():
+                keep = max(2, int(len(s.points) * keep_frac))
+                while len(s.points) > keep:
+                    s.points.popleft()
+                    dropped += 1
+        return dropped * 64      # (delta_ms int, float) tuple estimate
+
 
 class Scraper:
     """Named background thread driving collectors + a registry scrape
@@ -217,6 +231,7 @@ class Scraper:
         self.collectors: List[Callable[[], None]] = list(collectors)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._beat = None
 
     @property
     def running(self) -> bool:
@@ -237,24 +252,40 @@ class Scraper:
         self.tsdb.record_snapshot(self.registry.snapshot(), now)
 
     def _run(self) -> None:
+        self._beat.guard(self._run_loop)
+
+    def _run_loop(self) -> None:
+        beat = self._beat
         while not self._stop.wait(self.interval_s):
+            beat.tick()
             try:
                 self.tick()
             except Exception as e:
                 _log.warning("tsdb_tick_failed",
                              error=f"{type(e).__name__}: {e}")
 
+    def _spawn(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="pio-tsdb-scraper", daemon=True)
+        self._thread.start()
+
     def start(self) -> bool:
         if self.interval_s <= 0 or self.running:
             return False
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="pio-tsdb-scraper", daemon=True)
-        self._thread.start()
+        if self._beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            self._beat = watchdog().register(
+                "scraper", budget_s=self.interval_s * 3.0 + 5.0,
+                restart=self._spawn)
+        self._spawn()
         return True
 
     def stop(self) -> None:
         self._stop.set()
+        beat, self._beat = self._beat, None
+        if beat is not None:
+            beat.close()
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=2.0)

@@ -12,6 +12,9 @@ the serve chain, and every storage backend:
   breaker.py   half-open circuit breaker, state on /metrics and /ready
   shed.py      bounded admission (503/429 + Retry-After), shed counters
   faults.py    deterministic chaos harness driving the seams above
+  watchdog.py  thread-liveness beats, stall stack dumps, loop restart
+  pressure.py  memory soft/hard watermarks: trim, shed, drain
+  scenarios.py declarative timed chaos scenarios + invariant gates
 
 Every resilience event lands in the PR-1 metrics registry
 (`pio_deadline_expired_total`, `pio_shed_total`, `pio_breaker_state`,
@@ -37,4 +40,10 @@ from predictionio_tpu.resilience.shed import (  # noqa: F401
 )
 from predictionio_tpu.resilience.faults import (  # noqa: F401
     FaultError, FaultInjector, FaultRule, faults,
+)
+from predictionio_tpu.resilience.watchdog import (  # noqa: F401
+    Beat, Superseded, Watchdog, watchdog,
+)
+from predictionio_tpu.resilience.pressure import (  # noqa: F401
+    MemoryGuard,
 )

@@ -1,0 +1,754 @@
+"""Declarative chaos scenarios with invariant gates.
+
+`pio-tpu chaos run <scenario>` (and the chaos tests/bench) composes the
+fault seams — the storage/serve seams from `faults.py`, the watchdog
+seams `thread.<role>.stall` / `thread.<role>.die`, and the pressure
+seams `mem.pressure.soft` / `mem.pressure.hard` — into timed scripts
+against a REAL in-process topology (servers on loopback, trained tiny
+model, open-loop failover client), then gates on invariants:
+
+  zero-failed     no client request ultimately failed (the failover
+                  loader retries 503s and follows leader redirects
+                  inside each request's budget — only a request NO
+                  router served counts)
+  fired-once      the watchdog detected the injected stall exactly once
+  recovered       the wedged/killed loop is ticking again (age small,
+                  not degraded) before the scenario ends
+  took-over       the standby holds the lease after a lease-loop death
+  shed+trimmed    soft memory pressure shed `surface=memory` AND
+                  released measurable ring bytes
+
+A violated invariant makes `run()` return `ok=False` (the CLI exits
+non-zero) — chaos regressions are loud, not a dashboard curiosity.
+
+Scenarios (see `names()` / `pio-tpu chaos list`):
+
+  refresher-stall  wedge the freshness loop; watchdog stack-dumps,
+                   supersedes, respawns; freshness recovers
+  refresher-die    kill the freshness loop; death counted, respawned
+                   with backoff
+  lease-failover   the leader's lease loop dies; its /ready degrades
+                   and the standby takes the lease on TTL expiry
+  mem-soft         forced soft watermark: bounded state trimmed, new
+                   work shed 503 surface=memory, full recovery
+  replica-kill     SIGKILL one supervised replica; the supervisor
+                   respawns it and it re-registers into routing
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.obs import get_logger, get_registry
+from predictionio_tpu.resilience.faults import FaultError, faults
+from predictionio_tpu.resilience.watchdog import watchdog
+
+_log = get_logger(__name__)
+
+# chaos-grade fleet timings (mirrors the cross-host test suite)
+FLEET_TIMINGS = dict(health_interval_s=0.1, heartbeat_s=0.1,
+                     eject_threshold=2, drain_timeout_s=2.0,
+                     lease_ttl_s=0.5)
+SCENARIO_STALL_S = 1.0          # watchdog stall threshold during a run
+SCENARIO_SWEEP_S = 0.05         # watchdog sweep cadence during a run
+
+
+class ScenarioViolation(AssertionError):
+    """A step or invariant found the system in a forbidden state."""
+
+
+def _http(port: int, method: str, path: str, body=None, key: str = ""):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    if key:
+        req.add_header("Authorization", f"Bearer {key}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            raw = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, None
+
+
+class OpenLoopLoader:
+    """Client hammer that fails over between ports the way a real fleet
+    client does: try each port, skip 307 leader redirects to the next
+    port, retry 503s — a request only counts as FAILED when no server
+    serves it within its budget."""
+
+    def __init__(self, ports: Sequence[int], threads: int = 2,
+                 budget_s: float = 10.0,
+                 body: Optional[Dict] = None):
+        self.ports = list(ports)
+        self.budget_s = budget_s
+        self.body = body or {"user": "u1", "num": 2}
+        self.halt = threading.Event()
+        self.statuses: List[int] = []
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"pio-chaos-load-{i}")
+            for i in range(threads)]
+
+    def _attempt(self, port: int) -> int:
+        try:
+            status, _ = _http(port, "POST", "/queries.json", self.body)
+            return status
+        except OSError:
+            return -1
+
+    def _one_request(self) -> int:
+        end = time.perf_counter() + self.budget_s
+        while time.perf_counter() < end and not self.halt.is_set():
+            for port in self.ports:
+                status = self._attempt(port)
+                if status == 200:
+                    return 200
+                # 307: leader redirect — try the next port by hand
+                # (urllib refuses to re-POST on 307); 5xx: retry
+            time.sleep(0.05)
+        return -1
+
+    def _run(self) -> None:
+        while not self.halt.is_set():
+            status = self._one_request()
+            if self.halt.is_set() and status != 200:
+                return              # torn down mid-request: not a failure
+            with self._lock:
+                self.statuses.append(status)
+
+    def start(self) -> "OpenLoopLoader":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    @property
+    def requests(self) -> int:
+        with self._lock:
+            return len(self.statuses)
+
+    @property
+    def failures(self) -> List[int]:
+        with self._lock:
+            return [s for s in self.statuses if s != 200]
+
+
+class ScenarioContext:
+    """Everything a scenario's steps and invariants can reach: the
+    topology under test, the load generator, metric baselines, and a
+    notes dict for cross-step measurements."""
+
+    def __init__(self, trained):
+        self.registry, self.engine = trained
+        self.servers: List = []        # stopped in reverse at teardown
+        self.agents: List = []
+        self.supervisor = None
+        self.loader: Optional[OpenLoopLoader] = None
+        self.ports: List[int] = []
+        self.server = None             # single-server topologies
+        self.leader = None             # router-pair topologies
+        self.standby = None
+        self.notes: Dict = {}
+        self._base: Dict[Tuple, float] = {}
+
+    # -- metrics ------------------------------------------------------------
+    def metric(self, name: str, **labels) -> float:
+        return get_registry().value(name, **labels)
+
+    def mark(self, name: str, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        self._base[key] = self.metric(name, **labels)
+
+    def delta(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        return self.metric(name, **labels) - self._base.get(key, 0.0)
+
+    # -- helpers ------------------------------------------------------------
+    def wait(self, pred: Callable[[], bool], timeout: float = 8.0,
+             interval: float = 0.02, msg: str = "condition") -> None:
+        end = time.perf_counter() + timeout
+        while time.perf_counter() < end:
+            if pred():
+                return
+            time.sleep(interval)
+        raise ScenarioViolation(f"timed out waiting for: {msg}")
+
+    def note(self, key: str, value) -> None:
+        self.notes[key] = value
+
+
+@dataclass
+class Scenario:
+    """One declarative chaos script: a topology builder, timed steps,
+    and end-of-run invariants. `watch` lists the (metric, labels)
+    series whose baselines are captured after setup so invariants can
+    assert on deltas."""
+    name: str
+    description: str
+    duration_s: float
+    setup: Callable[[ScenarioContext], None]
+    steps: Tuple[Tuple[float, str, Callable[[ScenarioContext], None]], ...]
+    invariants: Tuple[
+        Tuple[str, Callable[[ScenarioContext], Optional[str]]], ...]
+    watch: Tuple[Tuple[str, Dict[str, str]], ...] = ()
+    load: bool = True
+    load_budget_s: float = 10.0
+    load_threads: int = 2
+    tight_roles: Tuple[str, ...] = ()   # beats clamped to SCENARIO_STALL_S
+
+
+@dataclass
+class ScenarioReport:
+    name: str
+    ok: bool
+    violations: List[str] = field(default_factory=list)
+    requests: int = 0
+    failures: int = 0
+    elapsed_s: float = 0.0
+    notes: Dict = field(default_factory=dict)
+
+    def to_json(self) -> Dict:
+        return {"name": self.name, "ok": self.ok,
+                "violations": self.violations,
+                "requests": self.requests, "failures": self.failures,
+                "elapsedS": round(self.elapsed_s, 3),
+                "notes": self.notes}
+
+
+def format_report(report: ScenarioReport) -> str:
+    lines = [f"scenario {report.name}: "
+             f"{'PASS' if report.ok else 'FAIL'} "
+             f"({report.requests} requests, {report.failures} failed, "
+             f"{report.elapsed_s:.1f}s)"]
+    for v in report.violations:
+        lines.append(f"  VIOLATED: {v}")
+    for k, v in sorted(report.notes.items()):
+        lines.append(f"  note {k} = {v}")
+    return "\n".join(lines)
+
+
+# -- topology builders --------------------------------------------------------
+
+def train_tiny(app_name: str = "chaosapp", access_key: str = "CHAOSKEY"):
+    """A fresh in-memory storage registry with a trained tiny
+    recommendation instance (20 users x 15 items, rank 4) — enough to
+    serve real /queries.json under chaos without a dataset on disk.
+    Installs the registry as process default and returns
+    (registry, engine)."""
+    import numpy as np
+
+    from predictionio_tpu.core import (
+        CoreWorkflow, EngineParams, RuntimeContext,
+    )
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import (
+        AccessKey, App, StorageRegistry, set_default,
+    )
+    from predictionio_tpu.models import recommendation as rec
+
+    registry = StorageRegistry({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    set_default(registry)
+    apps = registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, app_name))
+    registry.get_meta_data_access_keys().insert(
+        AccessKey(access_key, app_id, ()))
+    events = registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(20):
+        for i in range(15):
+            if rng.rand() > 0.5:
+                continue
+            r = 5.0 if i % 3 == u % 3 else 1.0
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r})), app_id)
+    ctx = RuntimeContext(registry=registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name=app_name)),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=4,
+                                           seed=1)),))
+    CoreWorkflow.run_train(engine, params, ctx)
+    return registry, engine
+
+
+def _tighten(roles: Sequence[str], budget_s: float) -> None:
+    """Clamp the live budgets of the targeted roles so injected stalls
+    are detected on scenario timescales instead of production ones."""
+    for beat in watchdog().beats():
+        if beat.role in roles and not beat.closed:
+            beat.budget_s = min(beat.budget_s, budget_s)
+
+
+def _setup_refreshing_server(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+    srv = PredictionServer(
+        ServerConfig(ip="127.0.0.1", port=0, refresh_interval_s=0.2),
+        registry=ctx.registry, engine=ctx.engine)
+    srv.start()
+    ctx.server = srv
+    ctx.servers.append(srv)
+    ctx.ports = [srv.port]
+
+
+def _setup_plain_server(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.serving import PredictionServer, ServerConfig
+    srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                           registry=ctx.registry, engine=ctx.engine)
+    srv.start()
+    ctx.server = srv
+    ctx.servers.append(srv)
+    ctx.ports = [srv.port]
+    # pre-fill the tsdb rings so the soft-watermark trim has something
+    # measurable to release
+    scraper = getattr(srv, "_scraper", None)
+    if scraper is not None:
+        now = time.time()
+        for i in range(4):
+            scraper.tick(now=now + i)
+
+
+def _setup_router_pair(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.serving import (
+        FleetConfig, FleetServer, PredictionServer, ReplicaAgent,
+        ServerConfig,
+    )
+    leader = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0),
+        FleetConfig(replicas=0, **FLEET_TIMINGS),
+        registry=ctx.registry, engine=ctx.engine)
+    leader.start()
+    standby = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0),
+        FleetConfig(replicas=0, standby=True, **FLEET_TIMINGS),
+        registry=ctx.registry, engine=ctx.engine)
+    standby.start()
+    replica = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                               registry=ctx.registry, engine=ctx.engine)
+    replica.start()
+    agent = ReplicaAgent(
+        replica,
+        [f"http://127.0.0.1:{leader.port}",
+         f"http://127.0.0.1:{standby.port}"],
+        heartbeat_s=0.1)
+    agent.start()
+    ctx.leader, ctx.standby = leader, standby
+    ctx.servers += [replica, standby, leader]
+    ctx.agents.append(agent)
+    ctx.ports = [leader.port, standby.port]
+    ctx.wait(lambda: leader.is_leader(), msg="first router takes lease")
+    ctx.wait(lambda: _admitted_remote(leader) >= 1
+             and _admitted_remote(standby) >= 1,
+             msg="replica admitted on both routers")
+
+
+def _admitted_remote(router) -> int:
+    return sum(1 for r in list(router._replicas) if r.admitted)
+
+
+def _setup_supervised(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.serving import FleetConfig, FleetServer, \
+        ServerConfig
+    from predictionio_tpu.serving.supervisor import (
+        ChildSpec, Supervisor, stub_child_argv,
+    )
+    router = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0),
+        FleetConfig(replicas=0, **FLEET_TIMINGS),
+        registry=ctx.registry, engine=ctx.engine)
+    router.start()
+    url = f"http://127.0.0.1:{router.port}"
+    sup = Supervisor(
+        [ChildSpec(f"stub{i}",
+                   stub_child_argv(url, heartbeat_s=0.2, name=f"stub{i}"))
+         for i in range(2)],
+        grace_s=5.0, poll_s=0.1, backoff_base_s=0.3)
+    sup.start()
+    ctx.leader = router
+    ctx.servers.append(router)
+    ctx.supervisor = sup
+    ctx.ports = [router.port]
+    # child processes cold-start a Python interpreter: generous barrier
+    ctx.wait(lambda: _admitted_remote(router) >= 2, timeout=30.0,
+             msg="both stub replicas registered and admitted")
+
+
+# -- steps --------------------------------------------------------------------
+
+def _arm_stall(role: str, wedge_s: float = 30.0):
+    def step(ctx: ScenarioContext) -> None:
+        faults().arm(f"thread.{role}.stall", latency=wedge_s, times=1)
+    return step
+
+
+def _arm_die(role: str):
+    def step(ctx: ScenarioContext) -> None:
+        faults().arm(f"thread.{role}.die", error=FaultError, times=1)
+    return step
+
+
+def _arm_soft_pressure(checks: int = 40):
+    def step(ctx: ScenarioContext) -> None:
+        faults().arm("mem.pressure.soft", times=checks)
+    return step
+
+
+def _vanish_leader_lease(ctx: ScenarioContext) -> None:
+    """Simulate the LEADER's lease thread dying (deterministically —
+    the `thread.lease.die` seam would race leader vs standby): point
+    the beat at a nonexistent thread ident. The sweep sees the thread
+    vanished (non-restartable -> degrade, /ready flips) and the real
+    loop exits Superseded on its next tick, so renewal stops and the
+    lease expires for the standby to claim."""
+    beat = ctx.leader._lease_beat
+    if beat is None:
+        raise ScenarioViolation("leader has no lease beat")
+    ctx.note("killed_leader_port", ctx.leader.port)
+    beat.thread_ident = -1
+
+
+def _kill_one_replica(ctx: ScenarioContext) -> None:
+    child = ctx.supervisor.find("stub0")
+    if child is None or child.proc is None:
+        raise ScenarioViolation("supervised child stub0 not running")
+    ctx.note("killed_pid", child.proc.pid)
+    t0 = time.perf_counter()
+    child.proc.kill()                       # SIGKILL: no drain, no mercy
+    ctx.wait(lambda: ctx.supervisor.alive_count() < 2
+             or _admitted_remote(ctx.leader) < 2, timeout=10.0,
+             msg="fleet/supervisor notices the kill")
+    ctx.wait(lambda: ctx.supervisor.alive_count() >= 2
+             and _admitted_remote(ctx.leader) >= 2, timeout=30.0,
+             msg="killed replica respawned and re-admitted")
+    ctx.note("recovery_s", round(time.perf_counter() - t0, 3))
+
+
+# -- invariants ---------------------------------------------------------------
+
+def _no_failed_requests(ctx: ScenarioContext) -> Optional[str]:
+    if ctx.loader is None:
+        return None
+    fails = ctx.loader.failures
+    if fails:
+        return (f"{len(fails)}/{ctx.loader.requests} client requests "
+                f"ultimately failed")
+    return None
+
+
+def _fired_once(role: str):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        d = ctx.delta("pio_watchdog_stalls_total", role=role)
+        if d != 1:
+            return f"watchdog stalls for {role}: {d:g} (expected 1)"
+        return None
+    return inv
+
+
+def _died_once(role: str):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        d = ctx.delta("pio_thread_deaths_total", role=role)
+        if d < 1:
+            return f"no death counted for {role}"
+        return None
+    return inv
+
+
+def _restarted(role: str, at_least: int = 1):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        d = ctx.delta("pio_thread_restarts_total", role=role)
+        if d < at_least:
+            return f"{role} restarted {d:g} times (expected >= {at_least})"
+        return None
+    return inv
+
+
+def _refresher_recovered(ctx: ScenarioContext) -> Optional[str]:
+    beat = ctx.server._refresher.beat
+    if beat is None:
+        return "refresher beat gone"
+    if beat.degraded:
+        return f"refresher degraded: {beat.reason}"
+    age = beat.age()
+    if age > 1.5:
+        return f"refresher not ticking (beat age {age:.2f}s)"
+    return None
+
+
+def _standby_took_over(ctx: ScenarioContext) -> Optional[str]:
+    if not ctx.standby.is_leader():
+        return "standby never took the lease"
+    return None
+
+
+def _old_leader_degraded(ctx: ScenarioContext) -> Optional[str]:
+    ready, detail = ctx.leader.readiness()
+    if ready:
+        return "old leader still reports ready after lease-loop death"
+    if "lease" not in detail.get("degradedLoops", []):
+        return f"lease not in degradedLoops: {detail}"
+    return None
+
+
+def _memory_shed(ctx: ScenarioContext) -> Optional[str]:
+    d = ctx.delta("pio_shed_total", surface="memory", app="")
+    if d < 1:
+        return "no requests shed with surface=memory"
+    return None
+
+
+def _memory_trimmed(ctx: ScenarioContext) -> Optional[str]:
+    freed = sum(
+        ctx.delta("pio_mem_trimmed_bytes_total", target=t)
+        for t in ("tsdb", "trace", "quality", "tenant_keys",
+                  "ingest_cache"))
+    if ctx.delta("pio_mem_trims_total", target="tsdb") < 1:
+        return "soft watermark never ran a trim pass"
+    if freed <= 0:
+        return "trim passes released no measurable bytes"
+    ctx.note("trimmed_bytes", int(freed))
+    return None
+
+
+def _pressure_recovered(ctx: ScenarioContext) -> Optional[str]:
+    state = ctx.server._pressure.state
+    if state != "ok":
+        return f"pressure state still {state} after seam exhausted"
+    ready, _ = ctx.server.readiness()
+    if not ready:
+        return "server not ready again after soft pressure cleared"
+    return None
+
+
+def _replica_respawned(ctx: ScenarioContext) -> Optional[str]:
+    d = ctx.delta("pio_supervisor_respawns_total", child="stub0")
+    if d != 1:
+        return f"stub0 respawned {d:g} times (expected 1)"
+    if ctx.supervisor.alive_count() < 2:
+        return f"only {ctx.supervisor.alive_count()} children alive"
+    rec = ctx.notes.get("recovery_s")
+    if rec is None:
+        return "recovery time never recorded"
+    return None
+
+
+# -- the registry -------------------------------------------------------------
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def _define(sc: Scenario) -> Scenario:
+    SCENARIOS[sc.name] = sc
+    return sc
+
+
+_define(Scenario(
+    name="refresher-stall",
+    description="wedge the freshness loop; watchdog stack-dumps, "
+                "supersedes, respawns; freshness recovers",
+    duration_s=6.0,
+    setup=_setup_refreshing_server,
+    tight_roles=("refresher",),
+    watch=(("pio_watchdog_stalls_total", {"role": "refresher"}),
+           ("pio_thread_restarts_total", {"role": "refresher"})),
+    steps=((1.0, "wedge refresher tick for 30s",
+            _arm_stall("refresher")),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("watchdog fired exactly once",
+                 _fired_once("refresher")),
+                ("refresher restarted", _restarted("refresher")),
+                ("freshness loop ticking again", _refresher_recovered)),
+))
+
+_define(Scenario(
+    name="refresher-die",
+    description="kill the freshness loop; death counted, respawned "
+                "with backoff",
+    duration_s=5.0,
+    setup=_setup_refreshing_server,
+    tight_roles=("refresher",),
+    watch=(("pio_thread_deaths_total", {"role": "refresher"}),
+           ("pio_thread_restarts_total", {"role": "refresher"})),
+    steps=((1.0, "inject uncaught exception into refresher",
+            _arm_die("refresher")),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("death counted", _died_once("refresher")),
+                ("refresher restarted", _restarted("refresher")),
+                ("freshness loop ticking again", _refresher_recovered)),
+))
+
+_define(Scenario(
+    name="lease-failover",
+    description="the leader's lease loop dies; its /ready degrades and "
+                "the standby takes the lease on TTL expiry",
+    duration_s=6.0,
+    setup=_setup_router_pair,
+    load_budget_s=15.0,
+    steps=((1.5, "leader lease thread vanishes",
+            _vanish_leader_lease),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("standby took the lease", _standby_took_over),
+                ("old leader /ready degraded", _old_leader_degraded)),
+))
+
+_define(Scenario(
+    name="mem-soft",
+    description="forced soft watermark: bounded state trimmed, new "
+                "work shed 503 surface=memory, full recovery",
+    duration_s=6.0,
+    setup=_setup_plain_server,
+    load_budget_s=15.0,
+    watch=(("pio_shed_total", {"surface": "memory", "app": ""}),
+           ("pio_mem_trims_total", {"target": "tsdb"}),
+           ("pio_mem_trimmed_bytes_total", {"target": "tsdb"}),
+           ("pio_mem_trimmed_bytes_total", {"target": "trace"}),
+           ("pio_mem_trimmed_bytes_total", {"target": "quality"}),
+           ("pio_mem_trimmed_bytes_total", {"target": "tenant_keys"}),
+           ("pio_mem_trimmed_bytes_total", {"target": "ingest_cache"})),
+    steps=((0.5, "force soft watermark for ~2s of sweeps",
+            _arm_soft_pressure(40)),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("new work shed surface=memory", _memory_shed),
+                ("bounded state trimmed", _memory_trimmed),
+                ("pressure cleared, serving again",
+                 _pressure_recovered)),
+))
+
+_define(Scenario(
+    name="replica-kill",
+    description="SIGKILL one supervised replica; the supervisor "
+                "respawns it and it re-registers into routing",
+    duration_s=10.0,
+    setup=_setup_supervised,
+    load_budget_s=20.0,
+    watch=(("pio_supervisor_respawns_total", {"child": "stub0"}),),
+    steps=((1.0, "SIGKILL stub0 and await respawn+re-admission",
+            _kill_one_replica),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("replica respawned and fleet whole",
+                 _replica_respawned)),
+))
+
+
+def names() -> List[str]:
+    return sorted(SCENARIOS)
+
+
+def get(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; have: {', '.join(names())}")
+
+
+# -- the runner ---------------------------------------------------------------
+
+def run(name_or_scenario, trained=None) -> ScenarioReport:
+    """Execute one scenario end to end: build the topology, start the
+    open-loop load, fire the timed steps, evaluate the invariants,
+    tear everything down. Returns the report; `ok=False` on any
+    violated invariant (the CLI maps that to a non-zero exit)."""
+    sc = (name_or_scenario if isinstance(name_or_scenario, Scenario)
+          else get(name_or_scenario))
+    wd = watchdog()
+    saved = (wd.stall_s, wd.interval_s)
+    faults().clear()
+    violations: List[str] = []
+    ctx = ScenarioContext(trained if trained is not None else train_tiny())
+    t_start = time.perf_counter()
+    try:
+        wd.stall_s, wd.interval_s = SCENARIO_STALL_S, SCENARIO_SWEEP_S
+        wd.ensure_started()
+        _log.info("scenario_setup", scenario=sc.name)
+        sc.setup(ctx)
+        if sc.tight_roles:
+            _tighten(sc.tight_roles, SCENARIO_STALL_S)
+        for metric_name, labels in sc.watch:
+            ctx.mark(metric_name, **labels)
+        if sc.load:
+            ctx.loader = OpenLoopLoader(
+                ctx.ports, threads=sc.load_threads,
+                budget_s=sc.load_budget_s).start()
+        t0 = time.perf_counter()
+        for at_s, label, action in sorted(sc.steps, key=lambda s: s[0]):
+            delay = t0 + at_s - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            _log.info("scenario_step", scenario=sc.name, at_s=at_s,
+                      step=label)
+            try:
+                action(ctx)
+            except ScenarioViolation as e:
+                violations.append(f"step '{label}': {e}")
+            except Exception as e:   # noqa: BLE001 — fail loud, run on
+                violations.append(
+                    f"step '{label}' crashed: {type(e).__name__}: {e}")
+        tail = t0 + sc.duration_s - time.perf_counter()
+        if tail > 0:
+            time.sleep(tail)
+        if ctx.loader is not None:
+            ctx.loader.stop()
+        for label, inv in sc.invariants:
+            try:
+                problem = inv(ctx)
+            except ScenarioViolation as e:
+                problem = str(e)
+            except Exception as e:   # noqa: BLE001 — fail loud, run on
+                problem = f"invariant crashed: {type(e).__name__}: {e}"
+            if problem:
+                violations.append(f"{label}: {problem}")
+    finally:
+        faults().clear()
+        if ctx.loader is not None:
+            ctx.loader.stop()
+        if ctx.supervisor is not None:
+            try:
+                ctx.supervisor.stop()
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        for agent in ctx.agents:
+            try:
+                agent.stop()
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        for srv in reversed(ctx.servers):
+            try:
+                srv.stop()
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        wd.stall_s, wd.interval_s = saved
+    report = ScenarioReport(
+        name=sc.name, ok=not violations, violations=violations,
+        requests=ctx.loader.requests if ctx.loader is not None else 0,
+        failures=len(ctx.loader.failures) if ctx.loader is not None
+        else 0,
+        elapsed_s=time.perf_counter() - t_start, notes=ctx.notes)
+    _log.info("scenario_done", scenario=sc.name, ok=report.ok,
+              violations=len(violations))
+    return report

@@ -14,7 +14,10 @@ scales with concurrency instead of degrading.
 """
 
 from predictionio_tpu.serving.server import (  # noqa: F401
-    PredictionServer, ServerConfig,
+    PredictionServer, ServerConfig, install_signal_handlers,
+)
+from predictionio_tpu.serving.supervisor import (  # noqa: F401
+    ChildSpec, Supervisor,
 )
 from predictionio_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetServer, ReplicaAgent, fleet_config_from_env,

@@ -260,6 +260,12 @@ class FleetServer(HTTPServerBase):
         self._stopping = False
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # watchdog liveness: the health monitor is restartable, the
+        # lease loop is NOT — a dead lease loop forfeits leadership, so
+        # the watchdog degrades this router's /ready instead and a
+        # standby takes over on TTL expiry
+        self._monitor_beat = None
+        self._lease_beat = None
         self._fleet_obs = _fleet_metrics(self.metrics)
         # metrics federation: last-good member /metrics text by member
         # key (scraped over the upstream pool on the tsdb tick,
@@ -343,12 +349,15 @@ class FleetServer(HTTPServerBase):
         # router is leader immediately; a standby next to a live leader
         # observes the holder and stays passive
         self._lease_tick()
-        self._monitor = threading.Thread(
-            target=self._monitor_loop, name="pio-fleet-health", daemon=True)
-        self._monitor.start()
-        self._lease_thread = threading.Thread(
-            target=self._lease_loop, name="pio-fleet-lease", daemon=True)
-        self._lease_thread.start()
+        from predictionio_tpu.resilience.watchdog import watchdog
+        self._monitor_beat = watchdog().register(
+            "health", budget_s=self.fleet.health_interval_s * 3.0 + 5.0,
+            restart=self._spawn_monitor)
+        self._lease_beat = watchdog().register(
+            "lease", budget_s=self.fleet.lease_ttl_s + 5.0)
+        self._spawn_monitor()
+        self._spawn_lease()
+        watchdog().ensure_started()
         if not background and self._thread is not None:
             self._thread.join()
         return port
@@ -364,6 +373,7 @@ class FleetServer(HTTPServerBase):
             self._stopping = True
         self._monitor_stop.set()
         self._lease_stop.set()
+        self._close_beats()
         for rep in list(self._replicas):
             with rep.lock:
                 rep.admitted = False
@@ -398,16 +408,33 @@ class FleetServer(HTTPServerBase):
             self._stopping = True
         self._monitor_stop.set()
         self._lease_stop.set()
+        self._close_beats()
         if self._fsck_sched is not None:
             self._fsck_sched.stop()
         self.shutdown()
 
+    def _close_beats(self) -> None:
+        for beat in (self._monitor_beat, self._lease_beat):
+            if beat is not None:
+                beat.close()
+        self._monitor_beat = None
+        self._lease_beat = None
+
     def readiness(self):
-        """/ready: the fleet serves while >=1 member is admitted."""
+        """/ready: the fleet serves while >=1 member is admitted AND
+        no non-restartable control loop has been given up on — a dead
+        lease loop cannot renew leadership, so this router must fail
+        readiness and let a standby take over on TTL expiry."""
         admitted = [r.index for r in self._replicas
                     if r.admitted and r.running()]
         detail = {"replicas": len(self._replicas), "admitted": admitted,
                   "leader": self._is_leader}
+        dead_loops = [b.role for b in (self._monitor_beat,
+                                       self._lease_beat)
+                      if b is not None and b.degraded]
+        if dead_loops:
+            detail["degradedLoops"] = dead_loops
+            return (False, detail)
         # worst-case SLO burn across the in-process replicas, so the
         # router — the probe target operators actually watch — surfaces
         # degradation without walking members (remote members carry
@@ -500,9 +527,24 @@ class FleetServer(HTTPServerBase):
         _log.warning("leader_stepped_down", holder=self._holder,
                      leader=self._leader_hint)
 
+    def _spawn_lease(self) -> None:
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="pio-fleet-lease", daemon=True)
+        self._lease_thread.start()
+
     def _lease_loop(self) -> None:
+        beat = self._lease_beat
+        if beat is not None:
+            beat.guard(self._lease_body)
+        else:
+            self._lease_body()
+
+    def _lease_body(self) -> None:
+        beat = self._lease_beat
         interval = max(self.fleet.lease_ttl_s / 3.0, 0.02)
         while not self._lease_stop.wait(interval):
+            if beat is not None:
+                beat.tick()
             self._lease_tick()
 
     def _journal_roll(self, pending: List[str]) -> None:
@@ -699,8 +741,23 @@ class FleetServer(HTTPServerBase):
         if over and (data_path or stale):
             self._eject(rep, reason)
 
+    def _spawn_monitor(self) -> None:
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="pio-fleet-health", daemon=True)
+        self._monitor.start()
+
     def _monitor_loop(self) -> None:
+        beat = self._monitor_beat
+        if beat is not None:
+            beat.guard(self._monitor_body)
+        else:
+            self._monitor_body()
+
+    def _monitor_body(self) -> None:
+        beat = self._monitor_beat
         while not self._monitor_stop.wait(self.fleet.health_interval_s):
+            if beat is not None:
+                beat.tick()
             for rep in list(self._replicas):
                 with rep.lock:
                     skip = rep.state in ("reloading", "stopping")
@@ -1247,6 +1304,7 @@ class ReplicaAgent:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._router_down: Dict[str, bool] = {}
+        self.beat = None                # watchdog liveness stamp
 
     def start(self) -> None:
         if not self.advertise:
@@ -1256,12 +1314,25 @@ class ReplicaAgent:
                          routers=",".join(self.routers))
         if self.heartbeat_s <= 0:
             self.heartbeat_s = 1.0
+        if self.beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            # a dead agent means missed heartbeats and eventual fleet
+            # ejection of a healthy replica: restartable, tight budget
+            self.beat = watchdog().register(
+                "agent", budget_s=self.heartbeat_s * 3.0 + 5.0,
+                restart=self._spawn)
+        self._spawn()
+
+    def _spawn(self) -> None:
         self._thread = threading.Thread(
             target=self._loop, name="pio-replica-agent", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        beat, self.beat = self.beat, None
+        if beat is not None:
+            beat.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -1308,7 +1379,17 @@ class ReplicaAgent:
         return ok
 
     def _loop(self) -> None:
+        beat = self.beat
+        if beat is not None:
+            beat.guard(self._loop_body)
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
+        beat = self.beat
         while not self._stop.wait(self.heartbeat_s):
+            if beat is not None:
+                beat.tick()
             self._beat_all("/fleet/heartbeat")
 
 

@@ -536,6 +536,11 @@ class _MicroBatcher:
         # observed pow2 batch-size counts (≤ log2(batch_max) keys, so
         # bounded by construction); feeds warm_deploy bucket autotune
         self._size_counts: Dict[int, int] = {}
+        # the live drainer's watchdog beat (None while idle): a WEDGED
+        # drainer can't be killed or safely superseded (two drainers
+        # would race the queue), so the watchdog degrades the owner's
+        # /ready instead and the fleet routes around it
+        self._drain_beat = None
 
     def queue_delay_ewma(self) -> float:
         """Current smoothed enqueue->drain latency estimate (seconds)."""
@@ -687,8 +692,17 @@ class _MicroBatcher:
 
     def _drain_loop(self):
         batch: List[tuple] = []
+        from predictionio_tpu.resilience.watchdog import watchdog
+        # transient registration: a drainer lives for one busy burst
+        # and retires on an idle window; while live, a stall past the
+        # submit timeout means every waiter is already timing out
+        wd_beat = watchdog().register("drainer",
+                                      budget_s=self.submit_timeout_s)
+        wd_beat.attach()
+        self._drain_beat = wd_beat
         try:
             while True:
+                wd_beat.tick()
                 with self._lock:
                     # wait out the window — but a full batch forming
                     # mid-window notifies the condition and ships NOW
@@ -738,9 +752,15 @@ class _MicroBatcher:
             for _, _, done, slot, _, _, _ in stranded:
                 slot["error"] = e
                 done.set()
+            from predictionio_tpu.resilience.watchdog import _deaths
+            _deaths().labels(role="drainer").inc()
             _log.error("batch_drainer_crashed",
                        error=f"{type(e).__name__}: {e}",
                        stranded=len(stranded))
+        finally:
+            wd_beat.close()
+            if self._drain_beat is wd_beat:
+                self._drain_beat = None
 
     def close(self, timeout: float = 30.0) -> bool:
         """Stop admitting (new submits shed with 503) and wait for
@@ -886,9 +906,16 @@ class PredictionServer(HTTPServerBase):
         # CreateServer.scala:557-566)
         self._feedback_queue: "queue.Queue" = queue.Queue(
             maxsize=config.feedback_queue_max)
+        self._feedback_beat = None
         if config.feedback:
-            threading.Thread(target=self._drain_feedback, daemon=True,
-                             name="pio-feedback-drain").start()
+            from predictionio_tpu.resilience.watchdog import watchdog
+            # blocking-get loop: no tick cadence to budget against, so
+            # an infinite budget disables stall detection — the beat
+            # exists for death accounting + respawn only
+            self._feedback_beat = watchdog().register(
+                "feedback", budget_s=float("inf"),
+                restart=self._spawn_feedback)
+            self._spawn_feedback()
         # restart-recovery pass BEFORE the first model load: report-only
         # fsck + acting janitor, so a crashed train's ghost row can't
         # win get_latest_completed (PIO_FSCK_ON_STARTUP=off disables;
@@ -944,6 +971,22 @@ class PredictionServer(HTTPServerBase):
                 self, attribution_s=config.attribution_s,
                 metrics=self.metrics)
             self._joiner.start()
+        # memory-pressure guard: soft watermark trims this server's
+        # bounded state and sheds new work 503 surface=memory; hard
+        # fails /ready and starts the graceful drain. Swept by the
+        # watchdog thread (attach in start()), checked inline by tests.
+        from predictionio_tpu.resilience.pressure import MemoryGuard
+        self._pressure = MemoryGuard()
+        self._pressure.add_trim("tsdb", self.tsdb.trim)
+        self._pressure.add_trim(
+            "trace", lambda: trace.get_recorder().trim())
+        if self._quality is not None:
+            self._pressure.add_trim("quality", self._quality.trim)
+        self._pressure.add_trim("tenant_keys",
+                                self.admission.trim_key_cache)
+        from predictionio_tpu.ingest.pipeline import trim_prepared_cache
+        self._pressure.add_trim("ingest_cache", trim_prepared_cache)
+        self._pressure.on_hard(self._drain_on_pressure)
 
     # -- continuous observatory ---------------------------------------------
     def _obs_collectors(self):
@@ -1130,8 +1173,29 @@ class PredictionServer(HTTPServerBase):
         except OSError:
             pass                         # persistence is best-effort
 
+    def _own_beats(self):
+        """The watchdog beats whose degradation should flip THIS
+        server's /ready (never another server's beats in the shared
+        process — test suites run many servers side by side)."""
+        beats = []
+        if self._refresher is not None:
+            beats.append(self._refresher.beat)
+        if self._joiner is not None:
+            beats.append(self._joiner.beat)
+        if self._fsck_sched is not None:
+            beats.append(self._fsck_sched.beat)
+        if self._batcher is not None:
+            beats.append(self._batcher._drain_beat)
+        beats.append(self._feedback_beat)
+        scraper = self._scraper
+        if scraper is not None:
+            beats.append(scraper._beat)
+        return [b for b in beats if b is not None]
+
     def readiness(self):
-        """/ready: a model must be loaded and no storage breaker OPEN."""
+        """/ready: a model must be loaded, no storage breaker OPEN, no
+        owned loop thread given up on by the watchdog, and the memory
+        guard below its hard watermark."""
         states = {}
         try:
             states = self.ctx.registry.breaker_states()
@@ -1146,7 +1210,13 @@ class PredictionServer(HTTPServerBase):
         if slo:
             detail["slo"] = slo
             detail["sloDegraded"] = self._slo.degraded()
-        return (loaded and not open_breakers, detail)
+        degraded = [b.role for b in self._own_beats() if b.degraded]
+        if degraded:
+            detail["degradedLoops"] = degraded
+        if not self._pressure.ready():
+            detail["memPressure"] = self._pressure.detail()
+            return (False, detail)
+        return (loaded and not open_breakers and not degraded, detail)
 
     def current_instance_id(self) -> str:
         """Engine-instance id of the deployment currently serving, ""
@@ -1191,7 +1261,11 @@ class PredictionServer(HTTPServerBase):
                     # key-protected with a different key: let the bind
                     # retry surface EADDRINUSE
                     pass
-        return super().start(background)
+        port = super().start(background)
+        from predictionio_tpu.resilience.watchdog import watchdog
+        watchdog().attach_guard(self._pressure)
+        watchdog().ensure_started()
+        return port
 
     def _on_bound(self) -> None:
         if self._batcher is not None:
@@ -1211,6 +1285,11 @@ class PredictionServer(HTTPServerBase):
             if self._stopping:
                 return
             self._stopping = True
+        from predictionio_tpu.resilience.watchdog import watchdog
+        watchdog().detach_guard(self._pressure)
+        beat, self._feedback_beat = self._feedback_beat, None
+        if beat is not None:
+            beat.close()
         if self._refresher is not None:
             self._refresher.stop()
         if self._joiner is not None:
@@ -1229,6 +1308,26 @@ class PredictionServer(HTTPServerBase):
         # pre-compiles the shapes this run actually saw
         self._save_dispatch_state()
         self.shutdown()
+
+    def shutdown(self) -> None:
+        # every exit path (graceful stop() ends here, tests/benches
+        # call shutdown() directly) must detach the pressure guard —
+        # a stale guard on the singleton watchdog keeps getting swept
+        # against a dead server and eats armed mem.pressure.* fault
+        # hits meant for live ones
+        from predictionio_tpu.resilience.watchdog import watchdog
+        watchdog().detach_guard(self._pressure)
+        super().shutdown()
+
+    def _drain_on_pressure(self) -> None:
+        """Hard memory watermark: start the graceful drain off the
+        watchdog sweep thread — a clean stop() beats an OOM kill
+        mid-request. /ready is already failing, so the fleet has
+        stopped routing here by the time the socket closes."""
+        _log.error("mem_hard_watermark_draining",
+                   detail=self._pressure.detail())
+        threading.Thread(target=self.stop, daemon=True,
+                         name="pio-mem-drain").start()
 
     def _flush_feedback(self, timeout_s: float) -> None:
         """Bounded wait for the feedback worker to clear its queue
@@ -1372,6 +1471,11 @@ class PredictionServer(HTTPServerBase):
                 return self._fast_finish(
                     504, "deadline expired before processing", rid, keep,
                     t0, raw=raw, tenant=tenant)
+            if self._pressure.shedding():
+                self._shed_counter.labels(surface="memory", app="").inc()
+                return self._fast_finish(
+                    503, "memory pressure: shedding new work", rid, keep,
+                    t0, retry_after=1.0, raw=raw, tenant=tenant)
             if self.admission.enabled:
                 tenant = self.admission.resolve_raw(
                     _scan_access_key(raw.query_string),
@@ -1529,12 +1633,26 @@ class PredictionServer(HTTPServerBase):
             if resp.status != 201:
                 raise OSError(f"event server replied {resp.status}")
 
+    def _spawn_feedback(self) -> None:
+        threading.Thread(target=self._drain_feedback, daemon=True,
+                         name="pio-feedback-drain").start()
+
     def _drain_feedback(self) -> None:
+        beat = self._feedback_beat
+        if beat is not None:
+            beat.guard(self._drain_feedback_body)
+        else:
+            self._drain_feedback_body()
+
+    def _drain_feedback_body(self) -> None:
+        beat = self._feedback_beat
         policy = RetryPolicy(
             attempts=max(1, self.config.feedback_retries),
             base_delay=0.1, max_delay=2.0, retryable=(OSError,))
         while True:
             data, app = self._feedback_queue.get()
+            if beat is not None:
+                beat.tick()
             try:
                 call_with_retry(self._send_feedback, data, policy=policy)
                 self._serve_obs.feedback.labels(outcome="sent",
@@ -1579,6 +1697,10 @@ class PredictionServer(HTTPServerBase):
             # over quota); tenancy off -> tenant is None, open serve
             tenant = self.admission.resolve(req)
             app = tenant.label if tenant is not None else ""
+            if self._pressure.shedding():
+                self._shed_counter.labels(surface="memory", app=app).inc()
+                raise OverloadedError(
+                    "memory pressure: shedding new work", retry_after=1.0)
             t0 = time.perf_counter()
             try:
                 with self.admission.admit(tenant):
@@ -1668,6 +1790,37 @@ class PredictionServer(HTTPServerBase):
         # declines (return None) drops into the generic POST handler
         # registered above
         self.fast_route("POST", "/queries.json", self._fast_queries)
+
+
+def install_signal_handlers(server, on_stopped=None) -> None:
+    """Route SIGTERM/SIGINT through the server's graceful `stop()`
+    drain (accepted requests finish; new work sheds 503) instead of
+    dying mid-request. Explicit — never auto-installed by start(), so
+    embedding processes and test runners keep their own handlers.
+    `on_stopped` (optional) runs after the drain completes, e.g. the
+    CLI's exit flag. Main-thread only (signal module contract)."""
+    import signal
+
+    def _drain_and_exit():
+        try:
+            # servers without a graceful drain (dashboard, admin, event
+            # server) fall back to the plain shutdown
+            stop = getattr(server, "stop", None)
+            (stop if callable(stop) else server.shutdown)()
+        finally:
+            if on_stopped is not None:
+                on_stopped()
+
+    def _handle(signum, frame):
+        # the handler itself must return immediately: drain on a named
+        # thread so in-flight work (including the main loop) proceeds
+        _log.warning("signal_graceful_stop",
+                     signal=signal.Signals(signum).name)
+        threading.Thread(target=_drain_and_exit, daemon=True,
+                         name="pio-signal-stop").start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _handle)
 
 
 def _gen_pr_id() -> str:

@@ -101,26 +101,50 @@ class Refresher:
         self._m = _metrics(metrics if metrics is not None
                            else get_registry())
         self.last_outcome = ""          # test/introspection surface
+        self.beat = None                # watchdog liveness stamp
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        if self.beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            # budget: a tick may legitimately take a full-rebuild, so
+            # give several intervals of slack before a stall verdict
+            self.beat = watchdog().register(
+                "refresher", budget_s=self.interval_s * 3.0 + 5.0,
+                restart=self._spawn)
+        self._spawn()
+
+    def _spawn(self) -> None:
         self._thread = threading.Thread(
             target=self._loop, name="pio-refresher", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        beat, self.beat = self.beat, None
+        if beat is not None:
+            beat.close()
         t = self._thread
         if t is not None:
             t.join(min(10.0, self.interval_s + 5.0))
 
     def _loop(self) -> None:
+        beat = self.beat
+        if beat is not None:
+            beat.guard(self._loop_body)
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
         # fleet rolling variant: replicas start offset by stagger so at
         # most one folds at a time and a poisoned swap (rolled back)
         # never hits the whole fleet in the same instant
+        beat = self.beat
         if self.stagger_s > 0 and self._stop.wait(self.stagger_s):
             return
         while not self._stop.is_set():
+            if beat is not None:
+                beat.tick()
             try:
                 self.tick()
             except Exception:

@@ -227,6 +227,13 @@ class BoundedTenantMap:
         """Drop and return `key`'s entry (None when absent)."""
         return self._entries.pop(key, None)
 
+    def clear(self) -> int:
+        """Drop every entry (memory-pressure trim); returns the count
+        dropped. Entries rebuild lazily on next use."""
+        n = len(self._entries)
+        self._entries.clear()
+        return n
+
     def __len__(self) -> int:
         return len(self._entries)
 
@@ -276,6 +283,13 @@ class AdmissionController:
     @property
     def enabled(self) -> bool:
         return self.config.enabled
+
+    def trim_key_cache(self) -> int:
+        """Memory-pressure trim: drop the access-key cache (entries
+        re-validate against the DAO on next use — one bounded read per
+        returning key). Returns approximate bytes released."""
+        with self._lock:
+            return self._keys.clear() * 256
 
     # -- authentication ------------------------------------------------------
     def resolve(self, req: Request) -> Optional[TenantIdentity]:

@@ -144,6 +144,31 @@ def _quality_line(host: str, port: int,
             f"    canary {_fmt(canary.get('overlap'), '{:.0%}'):>6}")
 
 
+def _liveness_line(series: Dict) -> Optional[str]:
+    """Self-healing vitals from the watchdog/pressure rings: how many
+    loop beats are registered, the oldest beat age, degraded roles,
+    stall/restart rates, and the memory-pressure state. None before
+    the watchdog exports anything (event servers, old snapshots): top
+    degrades, never errors."""
+    beats = sum(1 for key, entry in series.items()
+                if key.startswith("pio_thread_beat_age_seconds{")
+                and entry["points"])
+    if not beats:
+        return None
+    age = _ring_latest(series, "pio_thread_beat_age_seconds", agg="max")
+    degraded = _ring_latest(series, "pio_thread_degraded")
+    stalls = _ring_latest(series, "pio_watchdog_stalls_total")
+    restarts = _ring_latest(series, "pio_thread_restarts_total")
+    mem = _ring_latest(series, "pio_mem_pressure_state", agg="max")
+    mem_s = "-" if mem is None else \
+        {0: "ok", 1: "soft", 2: "hard"}.get(int(mem), "?")
+    return (f"  beats {beats:>3} (oldest {_fmt(age, '{:.1f}s')})"
+            f"    degraded {_fmt(degraded, '{:.0f}'):>3}"
+            f"    stalls/s {_fmt(stalls, '{:.2f}'):>5}"
+            f"    restarts/s {_fmt(restarts, '{:.2f}'):>5}"
+            f"    mem {mem_s}")
+
+
 def top_view(host: str, port: int, timeout: float = 3.0,
              frames: int = 3) -> str:
     """One screenful of a running server's vitals from /tsdb.json +
@@ -170,6 +195,9 @@ def top_view(host: str, port: int, timeout: float = 3.0,
     quality = _quality_line(host, port, timeout)
     if quality is not None:
         lines.insert(3, quality)
+    liveness = _liveness_line(ring)
+    if liveness is not None:
+        lines.insert(3, liveness)
     for row in prof.get("top_self", [])[:frames]:
         lines.append(f"    {row['share']:>6.1%}  {row['frame']}")
     roles = prof.get("roles") or {}

@@ -168,6 +168,11 @@ _SLO_PREFIXES = ("pio_slo",)
 # the last rolling reload's canary overlap — "is the model any good"
 _QUALITY_PREFIXES = ("pio_pred_", "pio_canary_", "pio_feedback_join")
 
+# the self-healing plane: thread liveness beats, watchdog verdicts,
+# memory-pressure watermarks, and the replica supervisor
+_SELFHEAL_PREFIXES = ("pio_thread_", "pio_watchdog_", "pio_mem_",
+                      "pio_supervisor_")
+
 
 def _reactor_balance(snapshot: dict) -> str:
     """Per-reactor connection/request balance: one row per accept
@@ -322,6 +327,25 @@ def _serving_panel(snapshot: dict) -> str:
         return ("<h2>Serving performance</h2>"
                 "<p>No dispatch/compile/warmup activity recorded yet.</p>")
     return ("<h2>Serving performance</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
+
+
+def _selfheal_panel(snapshot: dict) -> str:
+    """Summary table of the self-healing families: loop beat ages and
+    degraded roles (watchdog), stall/restart/death counts, the
+    memory-pressure state machine, and supervised-child states — the
+    operator's first stop when /ready flips for no obvious reason."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_SELFHEAL_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Self-healing</h2>"
+                "<p>No watchdog/pressure/supervisor activity recorded "
+                "yet (watchdog off, or no loops registered).</p>")
+    return ("<h2>Self-healing</h2>"
             "<table border=1><tr><th>Family</th><th>Labels</th>"
             "<th>Type</th><th>Value</th></tr>" + "".join(rows)
             + "</table>")
@@ -500,7 +524,7 @@ def _metrics_page(metrics: MetricsRegistry, tsdb=None) -> str:
         + _serving_panel(snapshot) + _slo_panel(snapshot)
         + _quality_panel(snapshot)
         + _wire_panel(snapshot) + _tenancy_panel(snapshot)
-        + _durability_panel(snapshot) +
+        + _selfheal_panel(snapshot) + _durability_panel(snapshot) +
         "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"
         "<th>Value</th></tr>" + "".join(rows) + "</table></body></html>")

@@ -328,12 +328,31 @@ def _check_thread_names(tree: ast.AST, text: str,
         line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
         if "# lint: ok" in line:
             continue
-        if any(kw.arg == "name" for kw in node.keywords):
+        name_kw = next((kw for kw in node.keywords
+                        if kw.arg == "name"), None)
+        if name_kw is None:
+            yield (f"{rel}:{node.lineno}: threading.Thread without "
+                   "name= — profiler role attribution needs named "
+                   "threads (obs/profiler.py); pass name='pio-...' or "
+                   "mark '# lint: ok'")
             continue
-        yield (f"{rel}:{node.lineno}: threading.Thread without name= "
-               "— profiler role attribution needs named threads "
-               "(obs/profiler.py); pass name='pio-...' or mark "
-               "'# lint: ok'")
+        # the name must carry a role prefix: the profiler buckets by
+        # prefix, and the watchdog's stall dumps are useless against
+        # a thread named 'worker' — lambdas passed as target= have no
+        # function name to fall back on, so the prefix is the ONLY
+        # role signal
+        head = None
+        v = name_kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            head = v.value
+        elif isinstance(v, ast.JoinedStr) and v.values \
+                and isinstance(v.values[0], ast.Constant):
+            head = str(v.values[0].value)
+        if head is not None and not head.startswith(("pio-", "wire-")):
+            yield (f"{rel}:{node.lineno}: thread name {head!r} lacks a "
+                   "role prefix; use 'pio-<role>...' or 'wire-...' so "
+                   "the profiler/watchdog can attribute it, or mark "
+                   "'# lint: ok'")
 
 
 def _check_urlopen_timeout(tree: ast.AST, text: str,

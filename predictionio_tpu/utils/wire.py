@@ -526,6 +526,7 @@ class SelectorWire:
         self.index = index
         self._sendmsg_on = SENDMSG_ON if sendmsg is None else bool(sendmsg)
         self.stats = WireStats()
+        self.beat = None                # watchdog stamp (serve_forever)
         if workers <= 0:
             workers = _default_workers()
         self._n_workers = max(1, workers)
@@ -557,8 +558,17 @@ class SelectorWire:
             sel.register(self._listener, selectors.EVENT_READ, "accept")
         sel.register(self._wake_r, selectors.EVENT_READ, "wake")
         last_sweep = time.monotonic()
+        # watchdog liveness: the 1 s select timeout bounds the stamp
+        # interval even when idle. beat() is ONE GIL-atomic store —
+        # the only watchdog call allowed on the wire hot path. A wedged
+        # reactor cannot be restarted (it owns live sockets), so a
+        # stall degrades it for fleet ejection instead.
+        from predictionio_tpu.resilience.watchdog import watchdog
+        beat = self.beat = watchdog().register("reactor", budget_s=10.0)
+        beat.attach()
         try:
             while not self._stop:
+                beat.beat()
                 for key, _ in sel.select(1.0):
                     data = key.data
                     if data == "accept":
@@ -582,6 +592,7 @@ class SelectorWire:
                     last_sweep = now
                     self._sweep_idle(now)
         finally:
+            beat.close()
             self._done.set()
 
     def _accept(self) -> None:
