@@ -1456,6 +1456,86 @@ def bench_watchdog(u, i, r, n_users, n_items):
             f"{report.failures} failed)")
 
 
+def bench_elastic(u, i, r, n_users, n_items):
+    """Elastic-fleet gates: (1) a shortened diurnal loadsim trace fired
+    open-loop at a real replica — zero errors, p99.9 inside the chaos
+    gate; (2) the four elastic chaos scenarios as measured workloads:
+    flash-crowd and diurnal-1-N-1 must scale 1->N->1 with zero victim
+    drops, hot-key must serve the pivoted trace clean, handoff-budget
+    must admit at most one per-tenant budget across the leader kill."""
+    from predictionio_tpu.resilience import scenarios
+    from predictionio_tpu.tools import loadsim
+
+    # (1) trace replay against one replica: the diurnal builtin at a
+    # tenth of its wall clock (same rates, ~720 arrivals over 6 s)
+    server, _registry, _engine = _deploy_server(u, i, r, n_users, n_items)
+    try:
+        for q in range(20):
+            _post(server.port, {"user": f"u{q}", "num": 10})   # warm
+        sc = loadsim.scale_durations(
+            loadsim.scenario_from_dict(loadsim.BUILTIN["diurnal"]), 0.1)
+        t0 = time.perf_counter()
+        schedule = loadsim.build_schedule(sc)
+        build_s = time.perf_counter() - t0
+        emit("elastic_schedule_events", float(len(schedule)),
+             "count", 1.0)
+        emit("elastic_schedule_build_s", build_s, "s", 1.0)
+        runner = loadsim.LoadRunner(sc, [server.port])
+        runner.run(schedule)
+        res = runner.result
+        by = res.by_status()
+        errs = sum(v for s, v in by.items() if s not in (200, 429))
+        p999 = res.percentiles()[99.9] * 1e3
+        emit("elastic_loadsim_requests", float(sum(by.values())),
+             "requests", 1.0)
+        emit("elastic_loadsim_errors", float(errs), "count",
+             1.0 if errs == 0 else 0.0)
+        emit("elastic_loadsim_p999", p999, "ms",
+             1.0 if p999 < 2500.0 else 2500.0 / max(p999, 2500.0))
+        if errs:
+            raise SystemExit(
+                f"elastic: diurnal trace hit {errs} errors "
+                f"(statuses {sorted(by)})")
+        if not p999 < 2500.0:
+            raise SystemExit(
+                f"elastic: diurnal trace p99.9 {p999:.1f}ms >= 2500ms")
+    finally:
+        server.shutdown()
+
+    # (2) the chaos scenarios ARE the measured workloads
+    trained = scenarios.train_tiny()
+    gates = {}
+    for name in ("flash-crowd", "diurnal-1-N-1", "hot-key",
+                 "handoff-budget"):
+        report = scenarios.run(name, trained=trained)
+        gates[name] = report
+        if not report.ok:
+            raise SystemExit(f"elastic: scenario {name} failed: "
+                             + "; ".join(report.violations))
+        if report.failures:
+            raise SystemExit(
+                f"elastic: scenario {name} dropped "
+                f"{report.failures}/{report.requests} requests")
+        slug = name.replace("-", "_")
+        emit(f"elastic_{slug}_requests", float(report.requests),
+             "requests", 1.0)
+        emit(f"elastic_{slug}_failed", float(report.failures),
+             "count", 1.0 if report.failures == 0 else 0.0)
+    emit("elastic_flash_peak_children",
+         float(gates["flash-crowd"].notes["peak_children"]),
+         "children", 1.0)
+    emit("elastic_diurnal_peak_children",
+         float(gates["diurnal-1-N-1"].notes["peak_children"]),
+         "children", 1.0)
+    emit("elastic_hot_key_share",
+         float(gates["hot-key"].notes["hot_share"]), "frac", 1.0)
+    admitted = float(gates["handoff-budget"].notes["admitted_total"])
+    budget = float(gates["handoff-budget"].notes["admitted_budget"])
+    emit("elastic_handoff_admitted", admitted, "requests",
+         1.0 if admitted <= budget else budget / admitted)
+    emit("elastic_handoff_budget", budget, "requests", 1.0)
+
+
 def bench_serving(u, i, r, n_users, n_items):
     from predictionio_tpu.serving import PredictionServer, ServerConfig
 
@@ -3515,6 +3595,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_watchdog, u, i, r, n_users, n_items)
         return
+    if "--only-elastic" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_elastic, u, i, r, n_users, n_items)
+        return
     if "--only-serving" in sys.argv:
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_serving, u, i, r, n_users, n_items)
@@ -3549,6 +3633,7 @@ def main():
         section(bench_obs, u, i, r, n_users, n_items)
         section(bench_quality, u, i, r, n_users, n_items)
         section(bench_watchdog, u, i, r, n_users, n_items)
+        section(bench_elastic, u, i, r, n_users, n_items)
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)

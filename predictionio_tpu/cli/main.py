@@ -152,6 +152,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "learns membership from replica heartbeats, and "
                         "takes over the leadership lease when the "
                         "current leader's lease expires")
+    x.add_argument("--autoscale", choices=["on", "off"],
+                   help="with --supervised: let the router's control "
+                        "loop grow/retire replica child processes off "
+                        "its own tsdb ring — scale up on sustained "
+                        "p99/queue-delay/burn/shed breach, drain down "
+                        "on sustained idle, with hysteresis, cooldown "
+                        "and flap damping (PIO_AUTOSCALE; thresholds "
+                        "via PIO_AUTOSCALE_* env knobs)")
+    x.add_argument("--autoscale-min", type=int,
+                   help="autoscaler floor on supervised children "
+                        "(PIO_AUTOSCALE_MIN, default 1)")
+    x.add_argument("--autoscale-max", type=int,
+                   help="autoscaler ceiling on supervised children "
+                        "(PIO_AUTOSCALE_MAX, default 4)")
+    x.add_argument("--member-name",
+                   help="with --join: stable member name this replica "
+                        "reports in heartbeats (the autoscaler "
+                        "addresses scaled children by it)")
     x.add_argument("--mesh",
                    help="serving mesh spec (e.g. items=8): forces the "
                         "mesh-sharded serve plan — item factors "
@@ -277,6 +295,20 @@ def build_parser() -> argparse.ArgumentParser:
     y.add_argument("scenario", help="scenario name, or 'all'")
     y.add_argument("--json", action="store_true",
                    help="machine-readable reports on stdout")
+
+    # loadsim --------------------------------------------------------------
+    x = sub.add_parser(
+        "loadsim",
+        help="trace-driven open-loop traffic generator: per-app "
+             "non-homogeneous Poisson arrivals from declarative phases "
+             "(diurnal sinusoid, flash crowd, hot-key pivot), Zipf "
+             "user/item skew over millions of simulated users, mixed "
+             "query shapes incl. binary frames — coordinated-omission "
+             "safe, bench-format JSON results")
+    x.add_argument("loadsim_argv", nargs=argparse.REMAINDER,
+                   help="arguments for the simulator, e.g. -- "
+                        "--scenario flash-crowd --port 8000 --scale 0.2 "
+                        "(see `pio-tpu loadsim -- --help`)")
 
     # misc -----------------------------------------------------------------
     x = sub.add_parser(
@@ -432,15 +464,46 @@ def main(argv: Optional[list] = None) -> int:
                 port = server.start()
                 parent_argv = list(argv) if argv is not None \
                     else sys.argv[1:]
-                child_argv = child_argv_from_parent(
-                    parent_argv, f"http://127.0.0.1:{port}")
+                router_url = f"http://127.0.0.1:{port}"
+
+                def _child_spec(name: str) -> ChildSpec:
+                    return ChildSpec(name, child_argv_from_parent(
+                        parent_argv, router_url,
+                        extra=("--member-name", name)))
+
                 sup = Supervisor(
-                    [ChildSpec(f"replica{i}", list(child_argv))
+                    [_child_spec(f"replica{i}")
                      for i in range(args.supervised)])
                 sup.start()
+                scaling = ""
+                if args.autoscale == "on" or (
+                        args.autoscale is None
+                        and registry.config.get("PIO_AUTOSCALE", "")
+                        in ("1", "true", "on")):
+                    # the control loop rides the router's own scraper
+                    # tick (FleetServer._autoscale_tick) — attaching
+                    # the instance is all the wiring there is
+                    from predictionio_tpu.serving.autoscaler import (
+                        AutoscaleConfig, Autoscaler,
+                    )
+                    acfg = AutoscaleConfig.from_env()
+                    acfg = dataclasses.replace(
+                        acfg, enabled=True,
+                        min_children=(args.autoscale_min
+                                      if args.autoscale_min is not None
+                                      else acfg.min_children),
+                        max_children=(args.autoscale_max
+                                      if args.autoscale_max is not None
+                                      else acfg.max_children))
+                    server.autoscaler = Autoscaler(
+                        acfg, supervisor=sup, fleet=server,
+                        spec_factory=_child_spec)
+                    scaling = (f", autoscale "
+                               f"[{acfg.min_children}, "
+                               f"{acfg.max_children}]")
                 print(f"Fleet control plane started on {args.ip}:{port} "
                       f"({args.supervised} supervised replica "
-                      f"processes)", flush=True)
+                      f"processes{scaling})", flush=True)
                 try:
                     _serve_forever(server)
                 finally:
@@ -463,7 +526,8 @@ def main(argv: Optional[list] = None) -> int:
                     server, args.join.split(","),
                     advertise=args.advertise or "",
                     server_key=config.server_key,
-                    heartbeat_s=fc.heartbeat_s)
+                    heartbeat_s=fc.heartbeat_s,
+                    member_name=args.member_name or "")
                 agent.start()
                 print(f"Fleet replica started on {args.ip}:{port}, "
                       f"joined {args.join}", flush=True)
@@ -547,6 +611,12 @@ def main(argv: Optional[list] = None) -> int:
         if cmd == "status":
             _emit(ops.status(_registry()))
             return 0
+        if cmd == "loadsim":
+            from predictionio_tpu.tools import loadsim
+            sim_argv = list(args.loadsim_argv)
+            if sim_argv and sim_argv[0] == "--":
+                sim_argv = sim_argv[1:]
+            return loadsim.main(sim_argv)
         if cmd == "chaos":
             from predictionio_tpu.resilience import scenarios
             if args.chaos_command == "list":

@@ -188,13 +188,22 @@ class TenantQuota:
     the reference is multi-app on ingest only; serve-side quotas are
     this port's million-user follow-on). Every field except `appid` is
     Optional: None means 'inherit the server-wide default' so an
-    operator can raise one knob without freezing the rest."""
+    operator can raise one knob without freezing the rest.
+
+    `channel` scopes the row WITHIN an app: "" (the default) is the
+    app-wide row; a non-empty channel names a sub-quota that inherits
+    every unset field from the app-wide row, which in turn inherits
+    from the server default — a three-level resolution chain the
+    admission controller walks (channel.merged_over(app).merged_over(
+    default)). The field is LAST so `TenantQuota(*row)` positional
+    construction from pre-channel readers keeps working."""
     appid: int
     rate: Optional[float] = None         # token-bucket refill, req/s
     burst: Optional[float] = None        # bucket capacity, requests
     concurrency: Optional[int] = None    # in-flight cap (0 = unlimited)
     queue_max: Optional[int] = None      # micro-batch pending cap
     weight: Optional[float] = None       # DRR drain weight
+    channel: str = ""                    # "" = app-wide row
 
     def merged_over(self, other: "TenantQuota") -> "TenantQuota":
         """This row's explicit fields over `other`'s (defaults)."""
@@ -206,7 +215,8 @@ class TenantQuota:
                          else other.concurrency),
             queue_max=(self.queue_max if self.queue_max is not None
                        else other.queue_max),
-            weight=self.weight if self.weight is not None else other.weight)
+            weight=self.weight if self.weight is not None else other.weight,
+            channel=self.channel)
 
 
 @dataclass(frozen=True)
@@ -415,16 +425,18 @@ class TenantQuotas(abc.ABC):
 
     @abc.abstractmethod
     def upsert(self, quota: TenantQuota) -> None:
-        """Insert or fully replace the override row for `quota.appid`."""
+        """Insert or fully replace the override row for
+        `(quota.appid, quota.channel)`."""
 
     @abc.abstractmethod
-    def get(self, appid: int) -> Optional[TenantQuota]: ...
+    def get(self, appid: int,
+            channel: str = "") -> Optional[TenantQuota]: ...
 
     @abc.abstractmethod
     def get_all(self) -> List[TenantQuota]: ...
 
     @abc.abstractmethod
-    def delete(self, appid: int) -> None: ...
+    def delete(self, appid: int, channel: str = "") -> None: ...
 
 
 class SLOObjectives(abc.ABC):

@@ -34,7 +34,8 @@ class MemStorageClient:
         self.evaluation_instances: Dict[str, EvaluationInstance] = {}
         self.models: Dict[str, Model] = {}
         self.leases: Dict[str, Lease] = {}
-        self.tenant_quotas: Dict[int, TenantQuota] = {}
+        # (appid, channel) -> row; channel "" is the app-wide row
+        self.tenant_quotas: Dict[Tuple[int, str], TenantQuota] = {}
         self.slo_objectives: Dict[int, SLOObjective] = {}
         # (app_id, channel_id) -> event_id -> Event
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
@@ -233,20 +234,20 @@ class MemTenantQuotas(base.TenantQuotas):
 
     def upsert(self, quota: TenantQuota) -> None:
         with self.c.lock:
-            self.c.tenant_quotas[quota.appid] = quota
+            self.c.tenant_quotas[(quota.appid, quota.channel)] = quota
 
-    def get(self, appid: int) -> Optional[TenantQuota]:
+    def get(self, appid: int, channel: str = "") -> Optional[TenantQuota]:
         with self.c.lock:
-            return self.c.tenant_quotas.get(appid)
+            return self.c.tenant_quotas.get((appid, channel))
 
     def get_all(self) -> List[TenantQuota]:
         with self.c.lock:
             return [self.c.tenant_quotas[k]
                     for k in sorted(self.c.tenant_quotas)]
 
-    def delete(self, appid: int) -> None:
+    def delete(self, appid: int, channel: str = "") -> None:
         with self.c.lock:
-            self.c.tenant_quotas.pop(appid, None)
+            self.c.tenant_quotas.pop((appid, channel), None)
 
 
 class MemSLOObjectives(base.SLOObjectives):

@@ -68,8 +68,10 @@ META_DDL = (
         name TEXT PRIMARY KEY, holder TEXT NOT NULL,
         expires_ms INTEGER NOT NULL, journal TEXT NOT NULL)""",
     """CREATE TABLE IF NOT EXISTS tenant_quotas (
-        appid INTEGER PRIMARY KEY, rate REAL, burst REAL,
-        concurrency INTEGER, queue_max INTEGER, weight REAL)""",
+        appid INTEGER, rate REAL, burst REAL,
+        concurrency INTEGER, queue_max INTEGER, weight REAL,
+        channel TEXT NOT NULL DEFAULT '',
+        PRIMARY KEY (appid, channel))""",
     """CREATE TABLE IF NOT EXISTS slo_objectives (
         appid INTEGER PRIMARY KEY, latency_ms REAL, target REAL)""",
 )
@@ -81,6 +83,15 @@ META_DDL = (
 META_MIGRATIONS = (
     "ALTER TABLE engine_instances ADD COLUMN heartbeat INTEGER",
     "ALTER TABLE models_quarantine ADD COLUMN quarantined_at INTEGER",
+    # per-channel quotas: add the column everywhere; on Postgres also
+    # swap the single-column PK for a composite unique index (the
+    # `ON CONFLICT (appid, channel)` upsert target). sqlite rejects
+    # DROP CONSTRAINT (swallowed) and instead rebuilds the table in
+    # `_rebuild_tenant_quotas` — it cannot ALTER a primary key.
+    "ALTER TABLE tenant_quotas ADD COLUMN channel TEXT NOT NULL DEFAULT ''",
+    "ALTER TABLE tenant_quotas DROP CONSTRAINT tenant_quotas_pkey",
+    "CREATE UNIQUE INDEX IF NOT EXISTS tenant_quotas_app_channel "
+    "ON tenant_quotas (appid, channel)",
 )
 
 
@@ -109,6 +120,32 @@ class SQLiteStorageClient:
                     self.conn.execute(mig)
             except sqlite3.OperationalError:
                 pass  # column already exists (fresh DDL or prior migration)
+        self._rebuild_tenant_quotas()
+
+    def _rebuild_tenant_quotas(self) -> None:
+        """sqlite cannot ALTER a PRIMARY KEY: a store created before
+        per-channel quotas keeps PK(appid), and a channel upsert would
+        silently REPLACE the app-wide row instead of adding a sibling.
+        Detect the stale key via PRAGMA and rebuild the table with the
+        composite key, preserving every row."""
+        with self.lock:
+            cols = self.conn.execute(
+                "PRAGMA table_info(tenant_quotas)").fetchall()
+        pk = {row[1] for row in cols if row[5]}   # (cid, name, ..., pk)
+        if pk == {"appid", "channel"}:
+            return
+        ddl = next(d for d in META_DDL
+                   if "IF NOT EXISTS tenant_quotas" in d)
+        with self.lock, self.conn:
+            self.conn.execute(
+                "ALTER TABLE tenant_quotas RENAME TO tenant_quotas_old")
+            self.conn.execute(ddl)
+            self.conn.execute(
+                "INSERT INTO tenant_quotas (appid, rate, burst,"
+                " concurrency, queue_max, weight, channel)"
+                " SELECT appid, rate, burst, concurrency, queue_max,"
+                " weight, '' FROM tenant_quotas_old")
+            self.conn.execute("DROP TABLE tenant_quotas_old")
 
     def close(self) -> None:
         with self.lock:
@@ -502,34 +539,36 @@ class SQLiteTenantQuotas(base.TenantQuotas):
     def __init__(self, client: SQLiteStorageClient):
         self.c = client
 
-    _COLS = "appid, rate, burst, concurrency, queue_max, weight"
+    _COLS = "appid, rate, burst, concurrency, queue_max, weight, channel"
 
     def upsert(self, quota: TenantQuota) -> None:
         with self.c.lock, self.c.conn:
             self.c.conn.execute(
                 f"INSERT OR REPLACE INTO tenant_quotas ({self._COLS}) "
-                "VALUES (?,?,?,?,?,?)",
+                "VALUES (?,?,?,?,?,?,?)",
                 (quota.appid, quota.rate, quota.burst, quota.concurrency,
-                 quota.queue_max, quota.weight))
+                 quota.queue_max, quota.weight, quota.channel))
 
-    def get(self, appid: int) -> Optional[TenantQuota]:
+    def get(self, appid: int, channel: str = "") -> Optional[TenantQuota]:
         with self.c.lock:
             row = self.c.conn.execute(
-                f"SELECT {self._COLS} FROM tenant_quotas WHERE appid=?",
-                (appid,)).fetchone()
+                f"SELECT {self._COLS} FROM tenant_quotas "
+                "WHERE appid=? AND channel=?",
+                (appid, channel)).fetchone()
         return TenantQuota(*row) if row else None
 
     def get_all(self) -> List[TenantQuota]:
         with self.c.lock:
             rows = self.c.conn.execute(
                 f"SELECT {self._COLS} FROM tenant_quotas "
-                "ORDER BY appid").fetchall()
+                "ORDER BY appid, channel").fetchall()
         return [TenantQuota(*r) for r in rows]
 
-    def delete(self, appid: int) -> None:
+    def delete(self, appid: int, channel: str = "") -> None:
         with self.c.lock, self.c.conn:
             self.c.conn.execute(
-                "DELETE FROM tenant_quotas WHERE appid=?", (appid,))
+                "DELETE FROM tenant_quotas WHERE appid=? AND channel=?",
+                (appid, channel))
 
 
 class SQLiteSLOObjectives(base.SLOObjectives):

@@ -33,6 +33,19 @@ Scenarios (see `names()` / `pio-tpu chaos list`):
                    work shed 503 surface=memory, full recovery
   replica-kill     SIGKILL one supervised replica; the supervisor
                    respawns it and it re-registers into routing
+  flash-crowd      loadsim flash step against an autoscaled fleet:
+                   1 -> 2 under the surge, drain back to 1 after,
+                   zero victim drops, retirement never reads as a crash
+  diurnal-1-N-1    two-stage diurnal swing: 1 -> 3 -> 1 with hysteresis
+                   and per-victim graceful drain; eject/respawn
+                   counters must not move
+  hot-key          loadsim hot-key pivot (70% of arrivals onto one
+                   user) against a real server: zero errors, p99.9
+                   inside the gate
+  handoff-budget   one rate-limited tenant hammered across a leader
+                   crash: total admitted across both routers stays
+                   within rate x wall-time + ONE burst (the journaled
+                   bucket inheritance regression gate)
 """
 
 from __future__ import annotations
@@ -557,6 +570,423 @@ def _replica_respawned(ctx: ScenarioContext) -> Optional[str]:
     return None
 
 
+# -- elastic fleet: topologies, steps, invariants -----------------------------
+
+class _SignalLevel:
+    """Chaos seam for the load LEVEL as the autoscaler sees it: the
+    scenario scripts the ring aggregate (deterministic on chaos
+    timescales, like every other fault seam) while the traffic hitting
+    the router is real — what the invariants gate is the grow/drain/
+    admission behavior under live fire, not the scraper's sampling
+    luck."""
+
+    def __init__(self) -> None:
+        self._level = "calm"
+
+    def set(self, level: str) -> None:
+        self._level = level
+
+    def __call__(self):
+        from predictionio_tpu.serving.autoscaler import Signals
+        if self._level == "surge":
+            return Signals(qps=400.0, p99_s=0.9, shed_rps=5.0)
+        return Signals(qps=0.0, p99_s=0.001)
+
+
+class _Stopper:
+    """Adapts a background driver thread to the ctx.servers teardown
+    protocol (append LAST so it is stopped FIRST)."""
+
+    def __init__(self, ev: threading.Event, thread: threading.Thread):
+        self._ev = ev
+        self._thread = thread
+
+    def stop(self) -> None:
+        self._ev.set()
+        self._thread.join(2.0)
+
+
+def _setup_autoscaled(max_children: int):
+    """Router + supervisor with ONE stub child + an enabled Autoscaler
+    driven at chaos cadence by a scripted signal level."""
+    def setup(ctx: ScenarioContext) -> None:
+        from predictionio_tpu.serving import (
+            FleetConfig, FleetServer, ServerConfig,
+        )
+        from predictionio_tpu.serving.autoscaler import (
+            AutoscaleConfig, Autoscaler,
+        )
+        from predictionio_tpu.serving.supervisor import (
+            ChildSpec, Supervisor, stub_child_argv,
+        )
+        # eject needs headroom here: the invariant under test is that
+        # RETIREMENT never reads as suspicion, so a stub child starved
+        # of CPU for a couple of 0.1 s probe intervals by the loadsim
+        # surge (the whole scenario shares one pytest process) must not
+        # eject and fake a violation
+        timings = dict(FLEET_TIMINGS, eject_threshold=8)
+        router = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0),
+            FleetConfig(replicas=0, **timings),
+            registry=ctx.registry, engine=ctx.engine)
+        router.start()
+        url = f"http://127.0.0.1:{router.port}"
+
+        def spec(name: str) -> ChildSpec:
+            return ChildSpec(
+                name, stub_child_argv(url, heartbeat_s=0.2, name=name))
+
+        sup = Supervisor([spec("base0")], grace_s=5.0, poll_s=0.1,
+                         backoff_base_s=0.3)
+        sup.start()
+        level = _SignalLevel()
+        asc = Autoscaler(
+            AutoscaleConfig(
+                enabled=True, min_children=1, max_children=max_children,
+                breach_ticks=2, idle_ticks=3, cooldown_s=0.4,
+                flap_window_s=60.0, max_flips=8),
+            supervisor=sup, fleet=router, spec_factory=spec,
+            signals_fn=level)
+        router.autoscaler = asc
+        ctx.leader = router
+        ctx.servers.append(router)
+        ctx.supervisor = sup
+        ctx.ports = [router.port]
+        ctx.autoscaler = asc
+        ctx.signal_level = level
+        stop = threading.Event()
+
+        def drive() -> None:
+            while not stop.is_set():
+                asc.tick()
+                time.sleep(0.1)
+
+        th = threading.Thread(target=drive, daemon=True,
+                              name="pio-chaos-autoscale")
+        th.start()
+        ctx.servers.append(_Stopper(stop, th))
+        ctx.wait(lambda: _admitted_remote(router) >= 1, timeout=30.0,
+                 msg="base replica registered and admitted")
+    return setup
+
+
+_FLASH_DOC = {
+    "name": "chaos-flash", "seed": 11,
+    "apps": [{
+        "key": "CHAOSKEY", "name": "flashapp",
+        "n_users": 5000, "n_items": 200, "zipf_s": 1.1,
+        "phases": [{"kind": "flash", "duration_s": 8.0, "rps": 8.0,
+                    "peak_rps": 60.0, "at_s": 1.0, "ramp_s": 0.5,
+                    "hold_s": 3.0}],
+    }],
+}
+
+_HOTKEY_DOC = {
+    "name": "chaos-hotkey", "seed": 13,
+    "apps": [{
+        "key": "CHAOSKEY", "name": "hotapp",
+        "n_users": 2000, "n_items": 50, "zipf_s": 1.1,
+        "phases": [
+            {"kind": "steady", "duration_s": 2.0, "rps": 30.0},
+            {"kind": "hotkey", "duration_s": 4.0, "rps": 30.0,
+             "hot_frac": 0.7, "hot_user": 3},
+            {"kind": "steady", "duration_s": 2.0, "rps": 30.0},
+        ],
+    }],
+}
+
+
+def _note_loadsim(ctx: ScenarioContext, result) -> None:
+    by = result.by_status()
+    pct = result.percentiles()
+    ctx.note("loadsim_requests", sum(by.values()))
+    ctx.note("loadsim_errors",
+             sum(v for s, v in by.items() if s not in (200, 429)))
+    p999 = pct[99.9] * 1e3
+    ctx.note("loadsim_p999_ms",
+             round(p999, 2) if p999 != float("inf") else -1.0)
+
+
+def _flash_hits(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.tools import loadsim
+    sc = loadsim.scenario_from_dict(_FLASH_DOC)
+    runner = loadsim.LoadRunner(sc, ctx.ports, timeout_s=5.0)
+    th = threading.Thread(target=runner.run, daemon=True,
+                          name="pio-chaos-loadsim")
+    th.start()
+    ctx.loadsim_runner = runner
+    ctx.loadsim_thread = th
+    ctx.signal_level.set("surge")
+    ctx.wait(lambda: ctx.supervisor.alive_count() >= 2, timeout=25.0,
+             msg="autoscaler grew a child under the flash crowd")
+    ctx.wait(lambda: _admitted_remote(ctx.leader) >= 2, timeout=30.0,
+             msg="scaled child admitted into routing")
+    ctx.note("peak_children", ctx.supervisor.alive_count())
+
+
+def _crowd_subsides(ctx: ScenarioContext) -> None:
+    ctx.loadsim_thread.join(30.0)
+    ctx.signal_level.set("calm")
+    ctx.wait(lambda: ctx.autoscaler.target == 1, timeout=25.0,
+             msg="autoscaler decided to scale back down")
+    if not ctx.autoscaler.drain_idle(20.0):
+        raise ScenarioViolation("retirement drain never finished")
+    ctx.wait(lambda: ctx.supervisor.alive_count() == 1, timeout=10.0,
+             msg="victim process stopped after drain")
+    _note_loadsim(ctx, ctx.loadsim_runner.result)
+
+
+def _diurnal_peak(ctx: ScenarioContext) -> None:
+    ctx.signal_level.set("surge")
+    ctx.wait(lambda: ctx.autoscaler.target >= 3
+             and ctx.supervisor.alive_count() >= 3, timeout=40.0,
+             msg="fleet grew 1 -> 3 through sustained breach")
+    ctx.wait(lambda: _admitted_remote(ctx.leader) >= 3, timeout=30.0,
+             msg="scaled children admitted into routing")
+    ctx.note("peak_children", ctx.supervisor.alive_count())
+
+
+def _diurnal_trough(ctx: ScenarioContext) -> None:
+    ctx.signal_level.set("calm")
+    ctx.wait(lambda: ctx.autoscaler.target == 1, timeout=40.0,
+             msg="fleet shrank back to 1")
+    if not ctx.autoscaler.drain_idle(25.0):
+        raise ScenarioViolation("retirement drain never finished")
+    ctx.wait(lambda: ctx.supervisor.alive_count() == 1, timeout=15.0,
+             msg="victim processes stopped after drain")
+    ctx.wait(lambda: _admitted_remote(ctx.leader) == 1, timeout=10.0,
+             msg="membership forgets the retired children")
+
+
+def _hot_key_fire(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.tools import loadsim
+    sc = loadsim.scenario_from_dict(_HOTKEY_DOC)
+    sched = loadsim.build_schedule(sc)
+    hot = sum(1 for ev in sched if ev.user == 3)
+    ctx.note("hot_share", round(hot / max(len(sched), 1), 3))
+    runner = loadsim.LoadRunner(sc, ctx.ports, timeout_s=5.0)
+    _note_loadsim(ctx, runner.run(sched))
+
+
+# one rate-limited tenant across a leader crash: the numbers the
+# handoff-budget gate is computed from
+BUDGET_RATE = 3.0
+BUDGET_BURST = 15.0
+
+
+class _BudgetHammer:
+    """One-tenant closed hammer at a fixed attempt pace far above the
+    rate limit, failing over to the NEXT port only on connection
+    failure — a 429 is an answer (the budget spoke), not a reason to
+    shop the same request to another router."""
+
+    def __init__(self, ports: Sequence[int], key: str = "CHAOSKEY",
+                 interval_s: float = 0.05, threads: int = 2):
+        self.ports = list(ports)
+        self.key = key
+        self.interval_s = interval_s
+        self.halt = threading.Event()
+        self._lock = threading.Lock()
+        self.samples: List[Tuple[float, int, int]] = []
+        self.t0 = 0.0
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"pio-chaos-budget-{i}")
+            for i in range(threads)]
+
+    def _attempt(self, port: int) -> int:
+        try:
+            status, _ = _http(
+                port, "POST", f"/queries.json?accessKey={self.key}",
+                {"user": "u1", "num": 2})
+            return status
+        except OSError:
+            return -1
+
+    def _run(self) -> None:
+        while not self.halt.is_set():
+            status, port = -1, self.ports[0]
+            for port in self.ports:
+                status = self._attempt(port)
+                if status != -1:
+                    break
+            with self._lock:
+                self.samples.append((time.monotonic(), port, status))
+            time.sleep(self.interval_s)
+
+    def start(self) -> "_BudgetHammer":
+        self.t0 = time.monotonic()
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self.halt.set()
+        for t in self._threads:
+            t.join(5)
+
+    def admitted(self, port: Optional[int] = None) -> int:
+        with self._lock:
+            return sum(1 for _, p, s in self.samples
+                       if s == 200 and (port is None or p == port))
+
+
+def _setup_budget_pair(ctx: ScenarioContext) -> None:
+    from predictionio_tpu.serving import (
+        FleetConfig, FleetServer, PredictionServer, ReplicaAgent,
+        ServerConfig,
+    )
+    from predictionio_tpu.tenancy import TenancyConfig
+    tenancy = TenancyConfig(enabled=True, rate=BUDGET_RATE,
+                            burst=BUDGET_BURST)
+    leader = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0, tenancy=tenancy),
+        FleetConfig(replicas=0, **FLEET_TIMINGS),
+        registry=ctx.registry, engine=ctx.engine)
+    leader.start()
+    standby = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0, tenancy=tenancy),
+        FleetConfig(replicas=0, standby=True, **FLEET_TIMINGS),
+        registry=ctx.registry, engine=ctx.engine)
+    standby.start()
+    replica = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                               registry=ctx.registry, engine=ctx.engine)
+    replica.start()
+    agent = ReplicaAgent(
+        replica,
+        [f"http://127.0.0.1:{leader.port}",
+         f"http://127.0.0.1:{standby.port}"],
+        heartbeat_s=0.1)
+    agent.start()
+    ctx.leader, ctx.standby = leader, standby
+    ctx.servers += [replica, standby, leader]
+    ctx.agents.append(agent)
+    ctx.ports = [leader.port, standby.port]
+    ctx.wait(lambda: leader.is_leader(), msg="first router takes lease")
+    ctx.wait(lambda: _admitted_remote(leader) >= 1
+             and _admitted_remote(standby) >= 1,
+             msg="replica admitted on both routers")
+    ctx.hammer = _BudgetHammer(ctx.ports).start()
+
+
+def _crash_leader(ctx: ScenarioContext) -> None:
+    """Die the SIGKILL way: no drain, no lease release — the journaled
+    bucket snapshot stays in the lease record for the standby to
+    inherit on TTL expiry."""
+    ctx.note("admitted_before_crash", ctx.hammer.admitted())
+    ctx.leader.crash()
+    ctx.wait(lambda: ctx.standby.is_leader(), timeout=10.0,
+             msg="standby takes the lease after TTL expiry")
+
+
+def _budget_settles(ctx: ScenarioContext) -> None:
+    ctx.hammer.stop()
+    elapsed = time.monotonic() - ctx.hammer.t0
+    ctx.note("hammer_elapsed_s", round(elapsed, 2))
+    ctx.note("admitted_total", ctx.hammer.admitted())
+    ctx.note("admitted_standby",
+             ctx.hammer.admitted(port=ctx.standby.port))
+    # rate x wall-time + ONE burst, plus slack for the journal staleness
+    # at the instant of the crash (one lease TTL of refill)
+    budget = (BUDGET_RATE * elapsed + BUDGET_BURST
+              + BUDGET_RATE * 2 * FLEET_TIMINGS["lease_ttl_s"] + 2)
+    ctx.note("admitted_budget", round(budget, 1))
+
+
+def _scaled_back_to_base(ctx: ScenarioContext) -> Optional[str]:
+    alive = ctx.supervisor.alive_count()
+    if alive != 1:
+        return f"{alive} children alive at end (expected 1)"
+    if ctx.autoscaler.target != 1:
+        return f"autoscaler target {ctx.autoscaler.target} at end"
+    return None
+
+
+def _peaked(n: int):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        peak = ctx.notes.get("peak_children", 0)
+        if peak < n:
+            return f"peak children {peak} (expected >= {n})"
+        return None
+    return inv
+
+
+def _scale_decisions(ups: int, downs: int):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        du = ctx.delta("pio_autoscale_decisions_total", direction="up")
+        dd = ctx.delta("pio_autoscale_decisions_total",
+                       direction="down")
+        if du < ups or dd < downs:
+            return (f"decisions up={du:g} down={dd:g} "
+                    f"(expected >= {ups}/{downs})")
+        return None
+    return inv
+
+
+def _retirement_not_suspicion(victims: int):
+    """The scale-down path must read as RETIRE at every layer: no
+    eject transitions, no crash-loop respawn accounting for the
+    scaled children."""
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        ejected = ctx.delta("pio_fleet_transitions_total",
+                            event="eject")
+        if ejected > 0:
+            return (f"scale-down fed the suspicion/eject machinery "
+                    f"({ejected:g} eject transitions)")
+        retired = ctx.delta("pio_fleet_transitions_total",
+                            event="retire")
+        if retired < victims:
+            return (f"retire transitions {retired:g} "
+                    f"(expected >= {victims})")
+        for name in ("scale1", "scale2"):
+            if ctx.delta("pio_supervisor_respawns_total",
+                         child=name) > 0:
+                return f"retired child {name} hit the crash-loop breaker"
+        return None
+    return inv
+
+
+def _loadsim_clean(p999_gate_ms: float = 2500.0):
+    def inv(ctx: ScenarioContext) -> Optional[str]:
+        errs = ctx.notes.get("loadsim_errors")
+        if errs is None:
+            return "loadsim result never recorded"
+        if errs:
+            return f"{errs} loadsim requests errored"
+        p999 = ctx.notes.get("loadsim_p999_ms", -1.0)
+        if not 0 <= p999 <= p999_gate_ms:
+            return (f"loadsim p99.9 {p999}ms outside the "
+                    f"{p999_gate_ms}ms gate")
+        return None
+    return inv
+
+
+def _hot_pivot_skewed(ctx: ScenarioContext) -> Optional[str]:
+    share = ctx.notes.get("hot_share", 0.0)
+    if not 0.2 <= share <= 0.6:
+        return f"hot-user share {share} outside the pivot band [0.2, 0.6]"
+    return None
+
+
+def _budget_respected(ctx: ScenarioContext) -> Optional[str]:
+    admitted = ctx.notes.get("admitted_total", 0)
+    budget = ctx.notes.get("admitted_budget", 0.0)
+    if admitted > budget:
+        return (f"{admitted} admits across the handoff exceed the "
+                f"budget {budget} (double-burst regression)")
+    if admitted < 1:
+        return "hammer never got a single 200"
+    return None
+
+
+def _service_continued(ctx: ScenarioContext) -> Optional[str]:
+    if not ctx.standby.is_leader():
+        return "standby never took the lease"
+    if ctx.notes.get("admitted_standby", 0) < 1:
+        return "standby admitted nothing after the leader crash"
+    return None
+
+
 # -- the registry -------------------------------------------------------------
 
 SCENARIOS: Dict[str, Scenario] = {}
@@ -652,6 +1082,94 @@ _define(Scenario(
     invariants=(("zero failed client requests", _no_failed_requests),
                 ("replica respawned and fleet whole",
                  _replica_respawned)),
+))
+
+
+_define(Scenario(
+    name="flash-crowd",
+    description="loadsim flash step against an autoscaled fleet: "
+                "1 -> 2 under the surge, graceful drain back to 1, "
+                "zero victim drops",
+    duration_s=14.0,
+    setup=_setup_autoscaled(max_children=2),
+    load_budget_s=20.0,
+    watch=(("pio_autoscale_decisions_total", {"direction": "up"}),
+           ("pio_autoscale_decisions_total", {"direction": "down"}),
+           ("pio_fleet_transitions_total", {"event": "eject"}),
+           ("pio_fleet_transitions_total", {"event": "retire"}),
+           ("pio_supervisor_respawns_total", {"child": "scale1"}),
+           ("pio_supervisor_respawns_total", {"child": "scale2"})),
+    steps=((0.5, "flash crowd arrives (loadsim + signal surge)",
+            _flash_hits),
+           (9.0, "crowd subsides; drain the scaled child",
+            _crowd_subsides)),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("loadsim saw zero errors, p99.9 in gate",
+                 _loadsim_clean()),
+                ("fleet peaked at >= 2 children", _peaked(2)),
+                ("fleet back to 1 child", _scaled_back_to_base),
+                ("scale decisions counted", _scale_decisions(1, 1)),
+                ("retirement never read as suspicion",
+                 _retirement_not_suspicion(1))),
+))
+
+_define(Scenario(
+    name="diurnal-1-N-1",
+    description="two-stage diurnal swing: 1 -> 3 -> 1 with hysteresis "
+                "and per-victim graceful drain; eject/respawn counters "
+                "must not move",
+    duration_s=16.0,
+    setup=_setup_autoscaled(max_children=3),
+    load_budget_s=20.0,
+    watch=(("pio_autoscale_decisions_total", {"direction": "up"}),
+           ("pio_autoscale_decisions_total", {"direction": "down"}),
+           ("pio_fleet_transitions_total", {"event": "eject"}),
+           ("pio_fleet_transitions_total", {"event": "retire"}),
+           ("pio_supervisor_respawns_total", {"child": "scale1"}),
+           ("pio_supervisor_respawns_total", {"child": "scale2"})),
+    steps=((0.5, "morning peak: sustained breach to 3 children",
+            _diurnal_peak),
+           (8.0, "evening trough: drain back to 1", _diurnal_trough)),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("fleet peaked at 3 children", _peaked(3)),
+                ("fleet back to 1 child", _scaled_back_to_base),
+                ("scale decisions counted", _scale_decisions(2, 2)),
+                ("retirement never read as suspicion",
+                 _retirement_not_suspicion(2))),
+))
+
+_define(Scenario(
+    name="hot-key",
+    description="loadsim hot-key pivot (70% of arrivals onto one user) "
+                "against a real server: zero errors, p99.9 in gate",
+    duration_s=10.0,
+    setup=_setup_plain_server,
+    load_budget_s=15.0,
+    steps=((0.3, "run the hot-key trace (steady / pivot / steady)",
+            _hot_key_fire),),
+    invariants=(("zero failed client requests", _no_failed_requests),
+                ("loadsim saw zero errors, p99.9 in gate",
+                 _loadsim_clean()),
+                ("pivot actually skewed onto the hot user",
+                 _hot_pivot_skewed)),
+))
+
+_define(Scenario(
+    name="handoff-budget",
+    description="one rate-limited tenant across a leader crash: total "
+                "admits on both routers stay within rate x wall-time "
+                "+ one burst (journaled bucket inheritance)",
+    duration_s=9.0,
+    setup=_setup_budget_pair,
+    load=False,                      # the budget hammer IS the load
+    steps=((3.0, "leader crashes (no drain, no lease release)",
+            _crash_leader),
+           (8.0, "stop the hammer; compute the admit budget",
+            _budget_settles)),
+    invariants=(("admits within rate x time + one burst",
+                 _budget_respected),
+                ("standby took over and kept serving",
+                 _service_continued)),
 ))
 
 

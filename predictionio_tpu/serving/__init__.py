@@ -19,6 +19,9 @@ from predictionio_tpu.serving.server import (  # noqa: F401
 from predictionio_tpu.serving.supervisor import (  # noqa: F401
     ChildSpec, Supervisor,
 )
+from predictionio_tpu.serving.autoscaler import (  # noqa: F401
+    AutoscaleConfig, Autoscaler, Signals, ring_signals,
+)
 from predictionio_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetServer, ReplicaAgent, fleet_config_from_env,
 )

@@ -1,0 +1,339 @@
+"""Autoscaler: a control loop riding the fleet router's own telemetry.
+
+PR 14 gave the router a tsdb ring (per-member qps/p99/burn, shed rates,
+queue-delay history) and PR 16 gave it spawn/retire mechanics (the
+`Supervisor` children joining through `--join`, graceful drain).  This
+module closes the loop: read the ring the router already keeps, decide
+up/down/hold, and drive the supervisor's child count — no external
+metrics pipeline, no sidecar, the router scales itself off the same
+numbers an operator would read on `/fleet.html`.
+
+Control discipline (every knob is a `PIO_AUTOSCALE*` env var):
+
+  - HYSTERESIS: a breach (p99 / queue delay / SLO burn / shed rate over
+    threshold) must persist `breach_ticks` consecutive scraper ticks
+    before scaling up; idleness must persist `idle_ticks` before
+    scaling down.  One bad scrape is noise, not load.
+  - COOLDOWN: after any action, hold for `cooldown_s` — a freshly
+    spawned child needs time to join, warm and show up in the signals
+    before we judge whether it helped.
+  - BOUNDS: children stay within [min_children, max_children].
+  - FLAP DAMPING: at most `max_flips` actions inside `flap_window_s`;
+    a workload that oscillates across a threshold gets a stable fleet,
+    not a thrashing one.
+
+Retirement is drain-shaped, never death-shaped: the victim member is
+marked `retiring` on the router (out of rotation, heartbeats stay
+welcome), drained to zero inflight, then its process is stopped through
+`Supervisor.retire` which skips the crash-loop accounting — a scaled-
+down child must not look like a crash to the breaker, and must not
+increment the fleet's suspicion/eject counters (gated in
+tests/test_elastic.py and the `diurnal-1-N-1` chaos scenario).
+
+The pure decision core (`Autoscaler.decide`) is separated from the
+side-effecting driver (`Autoscaler.tick`) so the decision table —
+breach→up, idle→down, cooldown, damping, bounds — is unit-testable
+with a synthetic clock and no processes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, List, Optional
+
+from predictionio_tpu.obs import MetricsRegistry, get_logger
+
+_log = get_logger("serving.autoscaler")
+
+
+def _envf(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _envi(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    """Thresholds and damping for the control loop (PIO_AUTOSCALE*)."""
+    enabled: bool = False
+    min_children: int = 1
+    max_children: int = 4
+    p99_up_ms: float = 250.0      # member p99 breach threshold
+    delay_up_ms: float = 100.0    # batch queue-delay p99 breach
+    burn_up: float = 1.0          # SLO burn-rate breach (1.0 = on budget)
+    shed_up_rps: float = 0.5      # sustained sheds/s count as pressure
+    idle_qps_per_child: float = 5.0   # scale down when the survivors
+                                      # could absorb the whole load
+    breach_ticks: int = 3
+    idle_ticks: int = 8
+    cooldown_s: float = 10.0
+    flap_window_s: float = 120.0
+    max_flips: int = 3
+
+    @staticmethod
+    def from_env() -> "AutoscaleConfig":
+        return AutoscaleConfig(
+            enabled=os.environ.get("PIO_AUTOSCALE", "") in
+            ("1", "true", "on"),
+            min_children=_envi("PIO_AUTOSCALE_MIN", 1),
+            max_children=_envi("PIO_AUTOSCALE_MAX", 4),
+            p99_up_ms=_envf("PIO_AUTOSCALE_P99_MS", 250.0),
+            delay_up_ms=_envf("PIO_AUTOSCALE_DELAY_MS", 100.0),
+            burn_up=_envf("PIO_AUTOSCALE_BURN", 1.0),
+            shed_up_rps=_envf("PIO_AUTOSCALE_SHED_RPS", 0.5),
+            idle_qps_per_child=_envf("PIO_AUTOSCALE_IDLE_QPS", 5.0),
+            breach_ticks=_envi("PIO_AUTOSCALE_BREACH_TICKS", 3),
+            idle_ticks=_envi("PIO_AUTOSCALE_IDLE_TICKS", 8),
+            cooldown_s=_envf("PIO_AUTOSCALE_COOLDOWN_S", 10.0),
+            flap_window_s=_envf("PIO_AUTOSCALE_FLAP_WINDOW_S", 120.0),
+            max_flips=_envi("PIO_AUTOSCALE_MAX_FLIPS", 3))
+
+
+@dataclass(frozen=True)
+class Signals:
+    """One tick's aggregated view of the ring."""
+    qps: float = 0.0          # sum of pio_fleet_member_qps
+    p99_s: float = 0.0        # max member p99
+    burn: float = 0.0         # max member SLO burn rate
+    delay_s: float = 0.0      # max batch queue-delay p99
+    shed_rps: float = 0.0     # sum of pio_shed_total rates
+    balance: float = 0.0      # worst reactor balance (informational)
+
+
+def ring_signals(tsdb) -> Signals:
+    """Aggregate the router's tsdb ring into one Signals sample.  The
+    ring is the same store `/fleet.html` charts read — the autoscaler
+    sees exactly what the operator sees."""
+    qps = shed = 0.0
+    p99 = burn = delay = balance = 0.0
+    for key in tsdb.keys():
+        v = tsdb.latest(key)
+        if v is None:
+            continue
+        if key.startswith("pio_fleet_member_qps{"):
+            qps += v
+        elif key.startswith("pio_fleet_member_p99_seconds{"):
+            p99 = max(p99, v)
+        elif key.startswith("pio_fleet_member_burn{"):
+            burn = max(burn, v)
+        elif key.startswith("pio_fleet_member_reactor_balance{"):
+            balance = max(balance, v)
+        elif key.startswith("pio_shed_total{") and key.endswith(":rate"):
+            shed += v
+        elif (key.startswith("pio_queue_delay_seconds")
+              and key.endswith(":p99")):
+            delay = max(delay, v)
+    return Signals(qps=qps, p99_s=p99, burn=burn, delay_s=delay,
+                   shed_rps=shed, balance=balance)
+
+
+class Autoscaler:
+    """Decision state machine + the driver that acts on it.
+
+    `decide(sig, children, now)` is the pure core: it consumes one
+    signal sample and a synthetic clock, updates the hysteresis/flap
+    state, and returns 'up' | 'down' | 'hold'.  `tick()` is the
+    side-effecting wrapper the fleet scraper calls each cycle: gather
+    ring signals, decide, grow or retire through the supervisor."""
+
+    def __init__(self, config: AutoscaleConfig,
+                 supervisor=None,
+                 fleet=None,
+                 spec_factory: Optional[Callable[[str], object]] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 signals_fn: Optional[Callable[[], Signals]] = None):
+        self.config = config
+        self.supervisor = supervisor
+        self.fleet = fleet
+        self.spec_factory = spec_factory
+        self._signals_fn = signals_fn
+        self._lock = threading.Lock()
+        self._breach = 0
+        self._idle = 0
+        self._last_action_t = float("-inf")
+        self._actions: Deque[float] = deque()
+        self._grown: List[str] = []      # LIFO of children we spawned
+        self._seq = 0
+        self._retiring: List[str] = []   # names mid-drain
+        base = 0
+        if supervisor is not None:
+            base = len(supervisor.children())
+        self._target = max(config.min_children,
+                           min(base or config.min_children,
+                               config.max_children))
+        m = metrics
+        if m is None and fleet is not None:
+            m = fleet.metrics
+        self._g_children = self._c_decisions = None
+        if m is not None:
+            self._g_children = m.gauge(
+                "pio_autoscale_children",
+                "Autoscaler child-count target")
+            self._c_decisions = m.counter(
+                "pio_autoscale_decisions_total",
+                "Autoscaler scale actions", labels=("direction",))
+            self._g_children.set(float(self._target))
+
+    # -- pure decision core -------------------------------------------------
+
+    @property
+    def target(self) -> int:
+        return self._target
+
+    def decide(self, sig: Signals, children: int, now: float) -> str:
+        """Consume one sample; return 'up' | 'down' | 'hold'.  Updates
+        the hysteresis counters and, when returning an action, stamps
+        the cooldown/flap state — a deterministic state machine in
+        (samples, clock)."""
+        cfg = self.config
+        breach = (sig.p99_s * 1e3 > cfg.p99_up_ms
+                  or sig.delay_s * 1e3 > cfg.delay_up_ms
+                  or sig.burn > cfg.burn_up
+                  or sig.shed_rps > cfg.shed_up_rps)
+        survivors = max(children - 1, 0)
+        idle = (not breach
+                and sig.qps < cfg.idle_qps_per_child * survivors)
+        with self._lock:
+            self._breach = self._breach + 1 if breach else 0
+            self._idle = self._idle + 1 if idle else 0
+            if now - self._last_action_t < cfg.cooldown_s:
+                return "hold"
+            while self._actions and \
+                    now - self._actions[0] > cfg.flap_window_s:
+                self._actions.popleft()
+            if len(self._actions) >= cfg.max_flips:
+                return "hold"                      # damped
+            if self._breach >= cfg.breach_ticks and \
+                    children < cfg.max_children:
+                self._breach = self._idle = 0
+                self._last_action_t = now
+                self._actions.append(now)
+                return "up"
+            if self._idle >= cfg.idle_ticks and \
+                    children > cfg.min_children:
+                self._breach = self._idle = 0
+                self._last_action_t = now
+                self._actions.append(now)
+                return "down"
+        return "hold"
+
+    # -- side-effecting driver ----------------------------------------------
+
+    def signals(self) -> Signals:
+        if self._signals_fn is not None:
+            return self._signals_fn()
+        if self.fleet is not None:
+            return ring_signals(self.fleet.tsdb)
+        return Signals()
+
+    def tick(self, now: Optional[float] = None) -> str:
+        """One control cycle — called as a fleet scraper collector.
+        Standby routers observe but never act: only the lease holder
+        scales the fleet (the standby's counters reset so a fresh
+        leader starts with clean hysteresis)."""
+        if not self.config.enabled:
+            return "hold"
+        if self.fleet is not None and not self.fleet._is_leader:
+            with self._lock:
+                self._breach = self._idle = 0
+            return "hold"
+        t = time.monotonic() if now is None else now
+        sig = self.signals()
+        direction = self.decide(sig, self._target, t)
+        if direction == "up":
+            self._grow()
+        elif direction == "down":
+            self._shrink()
+        if self._g_children is not None:
+            self._g_children.set(float(self._target))
+        return direction
+
+    def _grow(self) -> None:
+        if self.supervisor is None or self.spec_factory is None:
+            return
+        self._seq += 1
+        name = f"scale{self._seq}"
+        spec = self.spec_factory(name)
+        try:
+            self.supervisor.grow(spec)
+        except Exception as e:
+            _log.warning("autoscale_grow_failed", child=name,
+                         error=f"{type(e).__name__}: {e}")
+            return
+        self._grown.append(name)
+        self._target += 1
+        if self._c_decisions is not None:
+            self._c_decisions.labels(direction="up").inc()
+        _log.info("autoscale_up", child=name, target=self._target)
+
+    def _victim(self) -> Optional[str]:
+        """Prefer un-spawning our own children (LIFO); otherwise the
+        highest-named alive child — deterministic, so repeated
+        scale-downs walk the fleet in one order."""
+        if self._grown:
+            return self._grown.pop()
+        if self.supervisor is None:
+            return None
+        alive = sorted(c["name"] for c in self.supervisor.children()
+                       if c["alive"] and c["name"] not in self._retiring)
+        return alive[-1] if alive else None
+
+    def _shrink(self) -> None:
+        if self.supervisor is None:
+            return
+        name = self._victim()
+        if name is None:
+            return
+        self._target -= 1
+        self._retiring.append(name)
+        if self._c_decisions is not None:
+            self._c_decisions.labels(direction="down").inc()
+        _log.info("autoscale_down", child=name, target=self._target)
+        th = threading.Thread(target=self._retire, args=(name,),
+                              name="pio-autoscale-retire", daemon=True)
+        th.start()
+
+    def _retire(self, name: str) -> None:
+        """Drain-shaped retirement: router takes the member out of
+        rotation and drains it, THEN the process stops, THEN the
+        membership forgets it.  No step feeds the suspicion/eject
+        machinery or the crash-loop breaker."""
+        try:
+            rep_key = None
+            if self.fleet is not None:
+                rep = self.fleet.member_by_name(name)
+                if rep is not None:
+                    rep_key = rep.key
+                    self.fleet.retire_member(rep)
+            self.supervisor.retire(name)
+            if self.fleet is not None and rep_key:
+                self.fleet.forget_member(rep_key)
+        except Exception as e:
+            _log.warning("autoscale_retire_failed", child=name,
+                         error=f"{type(e).__name__}: {e}")
+        finally:
+            with self._lock:
+                if name in self._retiring:
+                    self._retiring.remove(name)
+
+    def drain_idle(self, timeout_s: float = 15.0) -> bool:
+        """Wait for in-flight retirements to finish (tests/scenarios)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._retiring:
+                    return True
+            time.sleep(0.05)  # lint: ok — bounded poll for test/scenario sync
+        return False

@@ -163,12 +163,15 @@ class _Replica:
         self.port = port
         self.lock = threading.Lock()
         self.admitted = False
-        self.state = "starting"   # serving|ejected|reloading|dead
+        # serving|ejected|reloading|dead|retiring (retiring = graceful
+        # scale-down drain: out of rotation, NOT suspicion)
+        self.state = "starting"
         self.failures = 0         # consecutive probe/route suspicions
         self.inflight = 0
         self.last_beat = time.monotonic()
         self.ejected_at = 0.0     # monotonic stamp of last eject evidence
         self.model_id = ""
+        self.name = ""            # supervisor child name, from heartbeats
 
     @property
     def key(self) -> str:
@@ -201,7 +204,7 @@ class _Replica:
                     "remote": self.server is None,
                     "state": self.state, "admitted": self.admitted,
                     "failures": self.failures, "inflight": self.inflight,
-                    "model": self.model_id,
+                    "model": self.model_id, "name": self.name,
                     "beat_age_s": round(time.monotonic() - self.last_beat, 3)}
 
 
@@ -257,6 +260,14 @@ class FleetServer(HTTPServerBase):
         # dominant cost (utils/wire.HTTPConnectionPool)
         self._upstream = HTTPConnectionPool()
         self._reload_lock = threading.Lock()
+        # the in-memory mirror of the lease journal's "roll" key: the
+        # single `_journal_payload` builder merges it with the
+        # admission bucket snapshot so the renewal tick and the roll
+        # path never clobber each other's half of the journal doc
+        self._roll_pending: List[str] = []
+        # attached control loop (serving/autoscaler.py); ticked from
+        # the tsdb scrape cycle when present
+        self.autoscaler = None
         self._stopping = False
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -475,8 +486,15 @@ class FleetServer(HTTPServerBase):
             return
         try:
             cur = self._leases.get(self._lease_name)
+            # a leader RENEWAL also journals its tenant-budget snapshot
+            # (plus any mid-roll state); an ACQUISITION passes None so
+            # the store preserves the dead leader's journal for
+            # `_become_leader` to inherit — writing here would destroy
+            # the very state a takeover needs to adopt
+            journal = self._journal_payload() if self._is_leader else None
             got = self._leases.acquire(
-                self._lease_name, self._holder, self.fleet.lease_ttl_s)
+                self._lease_name, self._holder, self.fleet.lease_ttl_s,
+                journal=journal)
         except Exception as e:
             # storage flake: keep the current role; if we are leader
             # and stay cut off, the TTL expires us from everyone
@@ -494,6 +512,18 @@ class FleetServer(HTTPServerBase):
             self._leader_hint = cur.holder if cur is not None else ""
             if self._is_leader:
                 self._step_down()
+            # continuously shadow the leader's journaled budgets: a
+            # standby that serves during the handoff gap (leader dead,
+            # lease not yet expired) charges buckets already synced to
+            # the leader's spent state — adoption is clamp-down-only,
+            # so the gap cannot mint a second per-tenant burst
+            if cur is not None and cur.journal:
+                try:
+                    doc = json.loads(cur.journal) or {}
+                except ValueError:
+                    doc = {}
+                if doc.get("buckets"):
+                    self.admission.adopt_buckets(doc)
 
     def _become_leader(self, previous: str, journal: str) -> None:
         self._is_leader = True
@@ -507,13 +537,23 @@ class FleetServer(HTTPServerBase):
         # rebuild membership a dead leader knew about (heartbeats to
         # all routers usually made this a no-op already)
         self._restore_members()
-        pending: List[str] = []
+        doc: dict = {}
         if journal:
             try:
-                pending = [str(k) for k in
-                           (json.loads(journal).get("roll") or [])]
+                doc = json.loads(journal) or {}
             except ValueError:
-                pending = []
+                doc = {}
+        # adopt the dead leader's spent tenant buckets BEFORE any
+        # request admits here: a takeover must continue the previous
+        # holder's budget, not mint a second burst per tenant
+        adopted = self.admission.adopt_buckets(doc)
+        if adopted:
+            _log.info("tenant_budget_adopted", tenants=adopted,
+                      previous=previous)
+        pending = [str(k) for k in (doc.get("roll") or [])]
+        # mirror immediately: a renewal tick before the resume thread
+        # journals again must not drop the roll key from the doc
+        self._roll_pending = list(pending)
         if pending:
             # the previous leader died mid-roll; finish what it started
             _log.warning("resuming_interrupted_roll", pending=pending)
@@ -547,15 +587,35 @@ class FleetServer(HTTPServerBase):
                 beat.tick()
             self._lease_tick()
 
+    def _journal_payload(self) -> str:
+        """The full journal doc a leader maintains: mid-roll progress
+        plus the admission spent-bucket snapshot. ONE builder for both
+        writers (the roll path and the renewal tick), so neither
+        clobbers the other's half of the doc."""
+        doc: dict = {}
+        if self._roll_pending:
+            doc["roll"] = list(self._roll_pending)
+        try:
+            snap = self.admission.export_buckets()
+        except Exception as e:
+            snap = {}
+            _log.warning("bucket_export_failed",
+                         error=f"{type(e).__name__}: {e}")
+        if snap:
+            doc["t"] = snap["t"]
+            doc["buckets"] = snap["buckets"]
+        return json.dumps(doc) if doc else ""
+
     def _journal_roll(self, pending: List[str]) -> None:
         """Record the members still to roll in the lease row (renewing
-        the lease as a side effect); an empty list clears the journal."""
+        the lease as a side effect); an empty list clears the roll key."""
+        self._roll_pending = list(pending)
         if self._leases is None or not self._is_leader:
             return
-        payload = json.dumps({"roll": pending}) if pending else ""
         try:
             self._leases.acquire(self._lease_name, self._holder,
-                                 self.fleet.lease_ttl_s, journal=payload)
+                                 self.fleet.lease_ttl_s,
+                                 journal=self._journal_payload())
         except Exception as e:
             _log.warning("roll_journal_write_failed",
                          error=f"{type(e).__name__}: {e}")
@@ -658,7 +718,12 @@ class FleetServer(HTTPServerBase):
         rep.beat(model_id=str(body.get("model", "")))
         ready = bool(body.get("ready", True))
         with rep.lock:
-            busy = rep.state in ("reloading", "stopping")
+            name = str(body.get("name", ""))
+            if name:
+                rep.name = name   # supervisor child name, for retirement
+            # retiring members stay out of rotation but keep beating:
+            # a drain-in-progress must not re-admit (nor eject) itself
+            busy = rep.state in ("reloading", "stopping", "retiring")
             if rep.state == "dead":
                 rep.state = "starting"
         if not busy:
@@ -760,7 +825,8 @@ class FleetServer(HTTPServerBase):
                 beat.tick()
             for rep in list(self._replicas):
                 with rep.lock:
-                    skip = rep.state in ("reloading", "stopping")
+                    skip = rep.state in ("reloading", "stopping",
+                                         "retiring")
                 self._fleet_obs["beat_age"].labels(
                     member=rep.key).set(rep.beat_age())
                 if skip:
@@ -778,12 +844,67 @@ class FleetServer(HTTPServerBase):
         self._fleet_obs["size"].set(float(len(members)))
         self._fleet_obs["members"].set(float(len(members)))
 
+    # -- elastic scale-down (drain != death) --------------------------------
+    def member_by_name(self, name: str) -> Optional[_Replica]:
+        """The member a supervisor child registered as: matched by the
+        heartbeat-carried child name, falling back to the stub model-id
+        convention (`stub-<name>`)."""
+        for rep in list(self._replicas):
+            if rep.name == name or rep.model_id == f"stub-{name}":
+                return rep
+        return None
+
+    def retire_member_named(self, name: str) -> bool:
+        rep = self.member_by_name(name)
+        if rep is None:
+            return False
+        return self.retire_member(rep)
+
+    def retire_member(self, rep: _Replica) -> bool:
+        """Graceful scale-down of one member: out of rotation, drained
+        to zero inflight, then forgotten. Counts as a `retire`
+        transition — NEVER an eject, and it leaves the suspicion
+        counters untouched (a retired child is a decision, not a
+        failure). Returns whether the drain completed inside the
+        drain-timeout budget."""
+        with rep.lock:
+            rep.admitted = False
+            rep.state = "retiring"
+        self._fleet_obs["transitions"].labels(event="retire").inc()
+        self._update_gauges()
+        _log.info("member_retiring", member=rep.key, name=rep.name)
+        drained = self._await_drain(rep)
+        if not drained:
+            _log.warning("retire_drain_timeout", member=rep.key,
+                         inflight=rep.inflight)
+        return drained
+
+    def forget_member(self, key: str) -> None:
+        """Remove a retired member from the roster and the persisted
+        snapshot; its later heartbeats (if the process lingers) would
+        simply re-register it."""
+        with self._members_lock:
+            self._replicas = [r for r in self._replicas if r.key != key]
+        self._persist_members()
+        self._update_gauges()
+        _log.info("member_forgotten", member=key)
+
     # -- metrics federation -------------------------------------------------
     def _obs_collectors(self):
         """The router's tsdb tick additionally scrapes every admitted
         member, so derived per-member gauges land in the router's own
         ring (one `/tsdb.json` holds the whole fleet's history)."""
-        return super()._obs_collectors() + [self._scrape_members]
+        return super()._obs_collectors() + [self._scrape_members,
+                                            self._autoscale_tick]
+
+    def _autoscale_tick(self) -> None:
+        """Drive the attached autoscaler (if any) once per tsdb scrape
+        cycle — it reads the ring `_scrape_members` just refreshed.
+        Attach-order-proof: the collector exists from construction and
+        no-ops until `self.autoscaler` is set."""
+        a = self.autoscaler
+        if a is not None:
+            a.tick()
 
     def _scrape_members(self) -> None:
         """Pull each admitted member's /metrics over the persistent
@@ -1295,12 +1416,15 @@ class ReplicaAgent:
 
     def __init__(self, server: PredictionServer, routers: Sequence[str],
                  advertise: str = "", server_key: str = "",
-                 heartbeat_s: float = 0.0):
+                 heartbeat_s: float = 0.0, member_name: str = ""):
         self.server = server
         self.routers = [u.rstrip("/") for u in routers if u]
         self.advertise = advertise
         self.server_key = server_key
         self.heartbeat_s = heartbeat_s
+        # supervisor child name (--member-name): lets the router map a
+        # member back to the child the autoscaler can retire
+        self.member_name = member_name
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._router_down: Dict[str, bool] = {}
@@ -1343,6 +1467,7 @@ class ReplicaAgent:
             ready = False
         return json.dumps({"member": self.advertise,
                            "model": self.server.current_instance_id(),
+                           "name": self.member_name,
                            "ready": bool(ready)}).encode()
 
     def _post(self, url: str, data: bytes) -> dict:

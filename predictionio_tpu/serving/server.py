@@ -256,6 +256,7 @@ _FAST_QUERY_RE = re.compile(
 # accessKey scanned straight out of the raw query string (the generic
 # path runs parse_qs over the whole thing)
 _ACCESS_KEY_RE = re.compile(r"(?:^|&)accessKey=([^&]*)")
+_CHANNEL_RE = re.compile(r"(?:^|&)channel=([^&]*)")
 
 _EMPTY_SCORES = b'{"itemScores": []}'
 
@@ -266,6 +267,21 @@ def _scan_access_key(qs: str) -> Optional[str]:
     if "accessKey" not in qs:
         return None
     m = _ACCESS_KEY_RE.search(qs)
+    if m is None:
+        return None
+    v = m.group(1)
+    if "%" in v or "+" in v:
+        v = unquote_plus(v)
+    return v
+
+
+def _scan_channel(qs: str) -> Optional[str]:
+    """Same raw-scan treatment for the optional per-app `channel`
+    selector so the binary/fast path resolves channel-scoped quotas
+    identically to the generic path."""
+    if "channel" not in qs:
+        return None
+    m = _CHANNEL_RE.search(qs)
     if m is None:
         return None
     v = m.group(1)
@@ -1479,7 +1495,8 @@ class PredictionServer(HTTPServerBase):
             if self.admission.enabled:
                 tenant = self.admission.resolve_raw(
                     _scan_access_key(raw.query_string),
-                    raw.header(TENANT_HEADER), raw.header("Authorization"))
+                    raw.header(TENANT_HEADER), raw.header("Authorization"),
+                    channel=_scan_channel(raw.query_string))
             with self._limiter:
                 admitted = True
                 with self.admission.admit(tenant):
@@ -1704,10 +1721,23 @@ class PredictionServer(HTTPServerBase):
             t0 = time.perf_counter()
             try:
                 with self.admission.admit(tenant):
-                    try:
-                        payload = req.json()
-                    except ValueError as e:
-                        raise HTTPError(400, str(e))
+                    ct = req.header("Content-Type") or ""
+                    if ct.startswith(BIN_CONTENT_TYPE):
+                        # binary SDK framing on the generic path: a
+                        # non-wire replica behind a fleet router must
+                        # speak the same frame the wire fast path does
+                        # (routers proxy bodies opaquely)
+                        decoded = decode_bin_query(req.body)
+                        if decoded is None:
+                            raise HTTPError(
+                                400, "malformed binary query frame")
+                        payload = {"user": decoded[0],
+                                   "num": decoded[1]}
+                    else:
+                        try:
+                            payload = req.json()
+                        except ValueError as e:
+                            raise HTTPError(400, str(e))
                     resp = Response.json(self._serve_one(payload,
                                                          tenant=tenant))
             except Exception as e:

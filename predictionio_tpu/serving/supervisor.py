@@ -76,6 +76,9 @@ class _Child:
         self.given_up = False
         self.respawns = 0
         self.last_rc: Optional[int] = None
+        # scale-down in progress: this child's exit is a DECISION, not
+        # a death — poll_once must not feed it to the crash-loop breaker
+        self.retiring = False
 
     @property
     def alive(self) -> bool:
@@ -85,6 +88,7 @@ class _Child:
         return {"name": self.spec.name, "alive": self.alive,
                 "pid": self.proc.pid if self.proc is not None else None,
                 "respawns": self.respawns, "givenUp": self.given_up,
+                "retiring": self.retiring,
                 "lastRc": self.last_rc}
 
 
@@ -181,6 +185,52 @@ class Supervisor:
                 return c
         return None
 
+    # -- elastic grow/retire -------------------------------------------------
+    def grow(self, spec: ChildSpec) -> None:
+        """Add one supervised slot at runtime and spawn it (autoscaler
+        scale-up). The new child gets the same respawn/breaker
+        treatment as the boot-time set."""
+        if self.find(spec.name) is not None:
+            raise ValueError(f"child {spec.name!r} already supervised")
+        child = _Child(spec)
+        with self._lock:
+            self._children.append(child)
+        self._spawn_child(child)
+        self._export_states()
+
+    def retire(self, name: str, grace_s: Optional[float] = None) -> bool:
+        """Gracefully stop one child and REMOVE its slot (autoscaler
+        scale-down). SIGTERM-first like stop(), but scoped to one
+        child; the retiring flag parks the watch loop so the exit is
+        never counted as a death (no backoff, no breaker, no respawn).
+        Returns False when no such child exists."""
+        child = self.find(name)
+        if child is None:
+            return False
+        child.retiring = True
+        proc = child.proc
+        if proc is not None and child.alive:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=grace_s if grace_s is not None
+                          else self.grace_s)
+            except subprocess.TimeoutExpired:
+                _log.warning("supervisor_retire_sigkill", child=name,
+                             pid=proc.pid)
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        with self._lock:
+            self._children = [c for c in self._children if c is not child]
+        _log.info("supervisor_child_retired", child=name)
+        self._export_states()
+        return True
+
     # -- spawning -----------------------------------------------------------
     def _spawn_child(self, child: _Child) -> None:
         env = dict(os.environ)
@@ -238,7 +288,7 @@ class Supervisor:
         with self._lock:
             children = list(self._children)
         for child in children:
-            if child.given_up:
+            if child.given_up or child.retiring:
                 continue
             if child.next_spawn_at is not None:
                 if now >= child.next_spawn_at and not self._stop.is_set():
@@ -279,7 +329,9 @@ def child_argv_from_parent(argv: Sequence[str], router_url: str,
     same deploy flags, minus the supervision/replica-count/port flags
     the child must not inherit, plus `--join` back to the router and
     an ephemeral port."""
-    drop_with_value = {"--supervised", "--replicas", "--port", "--join"}
+    drop_with_value = {"--supervised", "--replicas", "--port", "--join",
+                       "--autoscale", "--autoscale-min", "--autoscale-max",
+                       "--member-name"}
     drop_bare = {"--standby"}
     out: List[str] = []
     skip = False
@@ -342,7 +394,7 @@ def _run_stub(routers: List[str], server_key: str,
     server = _StubReplica()
     server.start(background=True)
     agent = ReplicaAgent(server, routers, server_key=server_key,
-                         heartbeat_s=heartbeat_s)
+                         heartbeat_s=heartbeat_s, member_name=name)
     agent.start()
     done = threading.Event()
 

@@ -79,9 +79,21 @@ class TenantIdentity:
     # identity arrived via the trusted fleet header: the leader already
     # charged this request's quota; do not charge it again here
     pre_admitted: bool = False
+    # sub-tenant within the app (`?channel=` on the query): channels
+    # get their own bucket/inflight state and may carry their own
+    # quota row, inheriting unset knobs from the app-wide row
+    channel: str = ""
 
     def header_value(self) -> str:
-        return f"{self.app_id}:{self.label}"
+        return f"{self.app_id}:{self.label}:{self.channel}"
+
+    @property
+    def state_key(self) -> str:
+        """Admission-state key: '/' cannot appear in a label or channel
+        (both are _LABEL_RE-checked), so app and app/channel never
+        collide."""
+        return f"{self.label}/{self.channel}" if self.channel \
+            else self.label
 
 
 @dataclass
@@ -227,6 +239,11 @@ class BoundedTenantMap:
         """Drop and return `key`'s entry (None when absent)."""
         return self._entries.pop(key, None)
 
+    def items(self):
+        """Snapshot of (key, value) pairs, oldest first (caller holds
+        whatever lock guards the map)."""
+        return list(self._entries.items())
+
     def clear(self) -> int:
         """Drop every entry (memory-pressure trim); returns the count
         dropped. Entries rebuild lazily on next use."""
@@ -267,6 +284,12 @@ class AdmissionController:
         # so a revoked key stops serving within the TTL instead of
         # living until LRU pressure happens to evict it
         self._keys = BoundedTenantMap(config.max_tenants)
+        # spent-bucket state inherited from a previous lease holder for
+        # tenants that have not sent us traffic yet: (tokens, rate,
+        # burst, monotonic adoption time), applied when the tenant's
+        # state is first created so a handoff cannot mint a fresh
+        # budget for a tenant mid-flood
+        self._inherited = BoundedTenantMap(config.max_tenants)
         self._warned_no_header_key = False
         self._shed = metrics.counter(
             "pio_shed_total", "Requests shed by surface at admission",
@@ -299,11 +322,12 @@ class AdmissionController:
             return None
         return self.resolve_raw(
             req.query_get("accessKey"), req.header(TENANT_HEADER),
-            req.header("Authorization"))
+            req.header("Authorization"), req.query_get("channel"))
 
     def resolve_raw(self, access_key: Optional[str],
                     tenant_header: Optional[str],
-                    authorization: Optional[str]
+                    authorization: Optional[str],
+                    channel: Optional[str] = None
                     ) -> Optional[TenantIdentity]:
         """Header-lite authentication for the wire fast path: the same
         decision tree as `resolve()` but fed the three raw values the
@@ -311,6 +335,8 @@ class AdmissionController:
         never materializes a Request or a dict of headers."""
         if not self.config.enabled:
             return None
+        if channel and not _LABEL_RE.fullmatch(channel):
+            raise HTTPError(400, "Invalid channel.")
         if self.config.trust_header and tenant_header:
             ident = self._parse_header(tenant_header)
             if ident is not None:
@@ -328,7 +354,7 @@ class AdmissionController:
             cached = self._keys.get(key)
         if cached is not None \
                 and now - cached[1] <= self.config.overrides_ttl_s:
-            return cached[0]
+            return self._with_channel(cached[0], channel)
         try:
             ak = self._access_keys().get(key)
         except HTTPError:
@@ -337,7 +363,7 @@ class AdmissionController:
             if cached is not None:
                 # metadata store down mid-revalidation: keep serving a
                 # key that WAS valid rather than 500ing live traffic
-                return cached[0]
+                return self._with_channel(cached[0], channel)
             raise HTTPError(
                 503, f"access-key store unavailable: "
                      f"{type(e).__name__}") from e
@@ -349,7 +375,16 @@ class AdmissionController:
         ident = TenantIdentity(app_id=ak.appid, label=label)
         with self._lock:
             self._keys.put(key, (ident, now))
-        return ident
+        return self._with_channel(ident, channel)
+
+    @staticmethod
+    def _with_channel(ident: TenantIdentity,
+                      channel: Optional[str]) -> TenantIdentity:
+        # the key cache stores the channel-less identity (one key, many
+        # channels); the channel is stamped on per request
+        if not channel or ident.channel == channel:
+            return ident
+        return replace(ident, channel=channel)
 
     def signed_header(self, tenant: TenantIdentity) -> str:
         """The X-PIO-App value a router asserts to its replicas:
@@ -385,15 +420,22 @@ class AdmissionController:
                           hashlib.sha256).hexdigest()
         if not hmac.compare_digest(sig, expect):
             return None
-        appid, sep, label = payload.partition(":")
-        if not sep or not _LABEL_RE.fullmatch(label):
+        appid, sep, rest = payload.partition(":")
+        if not sep:
+            return None
+        # `appid:label[:channel]` — the channel segment is absent in
+        # pre-channel assertions and empty for channel-less traffic
+        label, sep, channel = rest.partition(":")
+        if not _LABEL_RE.fullmatch(label):
+            return None
+        if channel and not _LABEL_RE.fullmatch(channel):
             return None
         try:
             app_id = int(appid)
         except ValueError:
             return None
         return TenantIdentity(app_id=app_id, label=label,
-                              pre_admitted=True)
+                              pre_admitted=True, channel=channel)
 
     def _access_keys(self):
         if self.registry is None:
@@ -426,19 +468,26 @@ class AdmissionController:
         return self._quota_dao
 
     def _load_quota(self, tenant: TenantIdentity) -> TenantQuota:
+        """Three-level resolution: channel row over app-wide row over
+        server default — each level fills only the knobs the level
+        above it left unset."""
         default = self.config.default_quota()
         dao = self._quotas_dao()
         if dao is None:
             return default
         try:
             row = dao.get(tenant.app_id)
+            ch_row = dao.get(tenant.app_id, tenant.channel) \
+                if tenant.channel else None
         except Exception as e:
             _log.warning("tenant_quota_read_failed", app=tenant.label,
                          error=f"{type(e).__name__}: {e}")
             return default
-        if row is None:
-            return default
-        return row.merged_over(default)
+        effective = row.merged_over(default) if row is not None \
+            else default
+        if ch_row is not None:
+            effective = ch_row.merged_over(effective)
+        return effective
 
     def _state(self, tenant: TenantIdentity) -> _TenantState:
         """The tenant's admission state, created or TTL-refreshed.
@@ -447,18 +496,19 @@ class AdmissionController:
         tenant — and the result lands under the lock with a
         double-check (a racing refresher's write is equivalent)."""
         with self._lock:
-            st = self._tenants.get(tenant.label)
+            st = self._tenants.get(tenant.state_key)
             if st is not None and (time.monotonic() - st.quota_loaded
                                    <= self.config.overrides_ttl_s):
                 return st
         quota = self._load_quota(tenant)     # no lock held
         with self._lock:
-            st = self._tenants.get(tenant.label)
+            st = self._tenants.get(tenant.state_key)
             if st is None:
                 st = _TenantState(
                     quota=quota,
                     bucket=_TokenBucket(quota.rate, quota.burst))
-                self._tenants.put(tenant.label, st)
+                self._apply_inherited(tenant.state_key, st)
+                self._tenants.put(tenant.state_key, st)
                 self._tenant_gauge.set(float(len(self._tenants)))
                 return st
             if quota != st.quota:
@@ -501,7 +551,7 @@ class AdmissionController:
             wait = st.bucket.try_take()
             if wait > 0.0:
                 self._shed.labels(surface="quota",
-                                  app=tenant.label).inc()
+                                  app=tenant.state_key).inc()
                 # a quota shed never reaches the serve path, so tag the
                 # pending trace with the shedding app here (error/status
                 # land at response encode)
@@ -513,7 +563,7 @@ class AdmissionController:
             cap = int(st.quota.concurrency or 0)
             if cap > 0 and st.inflight >= cap:
                 self._shed.labels(surface="quota",
-                                  app=tenant.label).inc()
+                                  app=tenant.state_key).inc()
                 trace.annotate_pending(trace.current(), app=tenant.label)
                 raise OverloadedError(
                     f"app '{tenant.label}' at its concurrency quota "
@@ -530,6 +580,95 @@ class AdmissionController:
         with self._lock:
             if st.inflight > 0:
                 st.inflight -= 1
+
+    # -- cross-router budget coordination ------------------------------------
+    # During a leader handoff, a standby that starts admitting with
+    # fresh (full) buckets grants every flooding tenant a SECOND burst
+    # — N routers, N× the budget. The leader therefore journals its
+    # spent-bucket snapshot through the lease row it already renews,
+    # and the standby that wins the lease adopts that state BEFORE it
+    # admits anything (fleet.py `_become_leader`). Wall-clock
+    # timestamps make the snapshot transferable across hosts: the
+    # adopter credits `elapsed × rate` for the dead-air window, so the
+    # inherited budget is exactly what the tenant would have accrued
+    # under one continuous router.
+
+    def export_buckets(self) -> dict:
+        """Spent token-bucket snapshot for the lease journal: tokens
+        left, refill rate and burst per tenant, stamped with the wall
+        clock so another host can age it."""
+        if not self.config.enabled:
+            return {}
+        out = {}
+        with self._lock:
+            mono = time.monotonic()
+            for key, st in self._tenants.items():
+                b = st.bucket
+                tokens = b.tokens
+                if b.rate > 0:
+                    tokens = min(b.burst,
+                                 tokens + (mono - b.t_last) * b.rate)
+                out[key] = {"tokens": round(tokens, 4),
+                            "rate": b.rate, "burst": b.burst}
+        # wall clock on purpose: the stamp must age across hosts
+        # (monotonic clocks are per-process)
+        return {"t": time.time(),  # lint: ok
+                "buckets": out} if out else {}
+
+    def adopt_buckets(self, doc: Optional[Mapping]) -> int:
+        """Inherit a previous lease holder's spent-bucket snapshot.
+        Existing buckets are clamped DOWN to the inherited level (never
+        raised: our own spend also counts); tenants we have not seen
+        yet are parked in a bounded map and applied when their state is
+        first created. Returns the number of tenants adopted."""
+        if not doc or not self.config.enabled:
+            return 0
+        buckets = doc.get("buckets") or {}
+        try:
+            age = max(0.0, time.time()  # lint: ok — cross-host stamp
+                      - float(doc.get("t", 0.0)))
+        except (TypeError, ValueError):
+            age = 0.0
+        n = 0
+        with self._lock:
+            mono = time.monotonic()
+            for key, rec in buckets.items():
+                try:
+                    tokens = float(rec["tokens"])
+                    rate = max(float(rec.get("rate", 0.0)), 0.0)
+                    burst = max(float(rec.get("burst", 1.0)), 1.0)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                inherited = min(burst, tokens + age * rate)
+                st = self._tenants.get(str(key))
+                if st is not None:
+                    # refill our own view to `mono` first: adoption may
+                    # run every renewal tick (standby shadowing), and
+                    # clamping a stale token count would silently
+                    # discard the refill accrued since t_last
+                    own = st.bucket.tokens
+                    if st.bucket.rate > 0:
+                        own = min(st.bucket.burst,
+                                  own + (mono - st.bucket.t_last)
+                                  * st.bucket.rate)
+                    st.bucket.tokens = min(own, inherited)
+                    st.bucket.t_last = mono
+                else:
+                    self._inherited.put(str(key),
+                                        (inherited, rate, burst, mono))
+                n += 1
+        return n
+
+    def _apply_inherited(self, key: str, st: _TenantState) -> None:
+        # under self._lock: first state creation for a tenant whose
+        # budget the previous leader journaled — start from the
+        # inherited level plus what accrued since adoption, not full
+        rec = self._inherited.pop(key)
+        if rec is None:
+            return
+        tokens, rate, _burst, adopted_mono = rec
+        accrued = tokens + (time.monotonic() - adopted_mono) * rate
+        st.bucket.tokens = min(st.bucket.tokens, accrued)
 
 
 class _AdmitGuard:

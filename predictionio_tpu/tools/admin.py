@@ -169,6 +169,28 @@ def _liveness_line(series: Dict) -> Optional[str]:
             f"    mem {mem_s}")
 
 
+def _autoscale_line(series: Dict) -> Optional[str]:
+    """Elastic-fleet vitals from the router's ring: the autoscaler's
+    child-count target, up/down decision rates, and the offered load it
+    is reacting to. None on servers without an autoscaler (replicas,
+    event servers): top degrades, never errors."""
+    children = _ring_latest(series, "pio_autoscale_children", agg="max")
+    if children is None:
+        return None
+    ups = _ring_latest(series,
+                       "pio_autoscale_decisions_total{direction=up}")
+    downs = _ring_latest(
+        series, "pio_autoscale_decisions_total{direction=down}")
+    qps = _ring_latest(series, "pio_fleet_member_qps{")
+    p99 = _ring_latest(series, "pio_fleet_member_p99_seconds{",
+                       agg="max")
+    return (f"  autoscale {_fmt(children, '{:.0f}'):>3} children"
+            f"    up/s {_fmt(ups, '{:.2f}'):>5}"
+            f"    down/s {_fmt(downs, '{:.2f}'):>5}"
+            f"    fleet qps {_fmt(qps):>8}"
+            f"    fleet p99 {_fmt(p99, '{:.1f}ms', 1e3):>8}")
+
+
 def top_view(host: str, port: int, timeout: float = 3.0,
              frames: int = 3) -> str:
     """One screenful of a running server's vitals from /tsdb.json +
@@ -198,6 +220,9 @@ def top_view(host: str, port: int, timeout: float = 3.0,
     liveness = _liveness_line(ring)
     if liveness is not None:
         lines.insert(3, liveness)
+    autoscale = _autoscale_line(ring)
+    if autoscale is not None:
+        lines.insert(3, autoscale)
     for row in prof.get("top_self", [])[:frames]:
         lines.append(f"    {row['share']:>6.1%}  {row['frame']}")
     roles = prof.get("roles") or {}

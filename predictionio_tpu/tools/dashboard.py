@@ -464,6 +464,13 @@ _FLEET_MEMBER_CHARTS = (
     ("Member 5m burn", ("pio_fleet_member_burn",)),
     ("Member reactor balance (max/mean)",
      ("pio_fleet_member_reactor_balance",)),
+    # elastic fleet: the autoscaler's child count charted against the
+    # offered load and tail latency it reacts to — the 1 -> N -> 1
+    # story of a flash crowd on one panel
+    ("Elastic fleet: children",
+     ("pio_autoscale_children", "pio_autoscale_decisions_total")),
+    ("Elastic fleet: offered load vs p99",
+     ("pio_fleet_member_qps", "pio_fleet_member_p99_seconds")),
 )
 
 
