@@ -1990,6 +1990,183 @@ def bench_fleet_crosshost(u, i, r, n_users, n_items):
          1.0 if failed[0] == 0 else 0.0)
 
 
+def bench_tiered(u, i, r, n_users, n_items):
+    """Giant-catalog gates (tiered factor storage + cross-host mesh):
+
+    (a) a synthetic catalog sized at 4x the env-capped HBM budget
+    (PIO_DEVICE_HBM_BYTES) serves through the demand-paged `TieredTopK`
+    selected by the REAL `serve_plan` auto mode. Zipf-skewed traffic
+    (a scattered popular head, so convergence genuinely requires
+    paging) runs to steady state through `PageManager.tick`; gates:
+    hot-set hit ratio >= 0.85, steady-state recompiles == 0 (including
+    a page swap inside the watch window), p99 <= 3x the all-resident
+    `BucketedTopK` baseline on the same catalog.
+
+    (b) a 2-member cross-host mesh (--mesh items=2@fleet) under open-
+    loop client load has one member killed mid-run; gate: ZERO failed
+    requests — degraded responses must be 200 + `partial: true`, and at
+    least one partial must be observed to prove the kill landed."""
+    import urllib.error
+
+    from predictionio_tpu.obs import compile_watch
+    from predictionio_tpu.ops.topk import BucketedTopK
+    from predictionio_tpu.ops.topk_sharded import serve_plan
+    from predictionio_tpu.ops.topk_tiered import TieredTopK
+    from predictionio_tpu.serving import FleetConfig, FleetServer, ServerConfig
+    from predictionio_tpu.serving.paging import PageManager
+    from predictionio_tpu.tools.loadsim import ZipfRanks
+
+    if remaining() < 90:
+        print(f"# budget: tiered skipped (remaining {remaining():.0f}s)",
+              file=sys.stderr)
+        return
+
+    # -- (a) tiered plan vs all-resident on 4x the device budget -------------
+    rank, k, batch = 32, 10, 8
+    budget = 4 * 1024 * 1024              # the env-capped HBM budget
+    n_big = 4 * budget // (rank * 4)      # catalog bytes = 4x the budget
+    rng = np.random.RandomState(17)
+    factors = (rng.randn(n_big, rank) / np.sqrt(rank)).astype(np.float32)
+    # Zipf head: 4096 popular items SCATTERED across the id space (the
+    # initial slab is the low-id prefix, so a high hit ratio is only
+    # reachable by actually paging the head in), boosted on the dim the
+    # traffic pins so every query's top-k lands in the head
+    head = rng.choice(n_big, 4096, replace=False)
+    factors[head, 0] += 4.0
+    zipf = ZipfRanks(head.shape[0], 1.1)   # the loadsim Zipf sampler
+
+    def zipf_batch():
+        v = rng.randn(batch, rank).astype(np.float32)
+        v[:, 0] = 3.0
+        # each arrival leans toward a Zipf-drawn head member, so the
+        # within-head serve distribution follows the loadsim trace law
+        v += 2.0 * factors[head[zipf.sample(rng, batch)]]
+        return v
+
+    env_keys = ("PIO_DEVICE_HBM_BYTES", "PIO_SERVE_TIER",
+                "PIO_TIER_HOT_FRAC")
+    saved_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ["PIO_DEVICE_HBM_BYTES"] = str(budget)
+    os.environ["PIO_SERVE_TIER"] = "auto"
+    os.environ.pop("PIO_TIER_HOT_FRAC", None)
+    try:
+        plan = serve_plan(factors, k=k, banned_width=64)
+    finally:
+        for key, val in saved_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    if not isinstance(plan, TieredTopK):
+        raise RuntimeError(
+            f"serve_plan picked {type(plan).__name__} for a catalog 4x "
+            "the device budget — tier auto mode is broken")
+    plan.warm()
+    baseline = BucketedTopK(factors, k=k, banned_width=64)
+    baseline.warm()
+    emit("tiered_catalog_over_budget_x",
+         factors.nbytes / budget, "x", 1.0)
+    emit("tiered_hot_slab_items", float(plan.hot_items), "items", 1.0)
+
+    pager = PageManager(interval_s=3600.0)   # ticked by hand: determinism
+    pager.bind([plan])
+    for _ in range(12):                      # converge the hot set
+        for _ in range(4):
+            plan(zipf_batch(), [()] * batch)
+        pager.tick()
+    if plan.page_count == 0:
+        raise RuntimeError("Zipf convergence phase never paged — the "
+                           "scattered head should force promotions")
+
+    # steady state: counters reset, every serve AND a page swap run
+    # under the compile watch — the zero-recompile gate covers paging
+    plan.hits = plan.served = 0
+    lat_t, lat_b = [], []
+    with compile_watch() as watch:
+        for step in range(40):
+            v = zipf_batch()
+            t0 = time.perf_counter()
+            plan(v, [()] * batch)
+            lat_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            baseline(v, [()] * batch)
+            lat_b.append(time.perf_counter() - t0)
+            if step == 19:
+                pager.tick()
+    hit = plan.hit_ratio()
+    p99_t = float(np.percentile(lat_t, 99)) * 1e3
+    p99_b = float(np.percentile(lat_b, 99)) * 1e3
+    emit("tiered_hit_ratio", hit, "ratio", hit / 0.85)
+    emit("tiered_steady_state_recompiles", float(watch.count), "compiles",
+         1.0 if watch.count == 0 else 0.0)
+    emit("tiered_p99_ms", p99_t, "ms", p99_b / p99_t)
+    emit("tiered_resident_p99_ms", p99_b, "ms", 1.0)
+    emit("tiered_promotions_total", float(plan.promotions_total),
+         "promotions", 1.0)
+    if hit < 0.85:
+        raise RuntimeError(f"tiered hit ratio {hit:.3f} < 0.85 gate")
+    if watch.count != 0:
+        raise RuntimeError(
+            f"{watch.count} steady-state recompiles (gate: 0)")
+    if p99_t > 3.0 * p99_b:
+        raise RuntimeError(f"tiered p99 {p99_t:.2f} ms > 3x all-resident "
+                           f"{p99_b:.2f} ms gate")
+
+    # -- (b) mesh member kill under load: zero failed requests ---------------
+    registry, engine = _train_registry(u, i, r, n_users, n_items)
+    fleet = FleetServer(
+        ServerConfig(ip="127.0.0.1", port=0, mesh="items=2@fleet"),
+        FleetConfig(replicas=2, health_interval_s=0.1, eject_threshold=2),
+        registry=registry, engine=engine)
+    port = fleet.start()
+    failed, partial, served = [0], [0], [0]
+    halt = threading.Event()
+    zipf_users = ZipfRanks(n_users, 1.1)
+
+    def client(tid):
+        crng = np.random.RandomState(1000 + tid)
+        while not halt.is_set():
+            user = int(zipf_users.sample(crng, 1)[0])
+            try:
+                out = _post(port, {"user": f"u{user}", "num": 10})
+            except (urllib.error.HTTPError, OSError, ValueError):
+                if not halt.is_set():
+                    failed[0] += 1
+                continue
+            served[0] += 1
+            if out.get("partial"):
+                partial[0] += 1
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    try:
+        for q in range(8):                   # warm both members' shards
+            _post(port, {"user": f"u{q}", "num": 10})
+        t_load = time.perf_counter()
+        for t in threads:
+            t.start()
+        halt.wait(0.4)                       # steady mesh traffic
+        fleet._replicas[1].server.shutdown()  # kill one member's serve plane
+        halt.wait(0.8)                       # degraded traffic window
+        window_s = time.perf_counter() - t_load
+    finally:
+        halt.set()
+        for t in threads:
+            t.join(15)
+        fleet.stop()
+    emit("tiered_mesh_qps", served[0] / window_s, "qps", 1.0)
+    emit("tiered_memberkill_partial_responses", float(partial[0]),
+         "responses", 1.0 if partial[0] > 0 else 0.0)
+    # the gate: a degraded shard means partial results, never an error
+    emit("tiered_memberkill_failed_requests", float(failed[0]), "requests",
+         1.0 if failed[0] == 0 else 0.0)
+    if failed[0] > 0:
+        raise RuntimeError(f"{failed[0]} requests failed through the "
+                           "member kill (gate: 0)")
+    if partial[0] == 0:
+        raise RuntimeError("no partial responses observed — the member "
+                           "kill never degraded the mesh")
+
+
 def bench_serving_large_catalog():
     """The round-2/3 ask: demonstrate batched DEVICE serving on a big
     catalog. 500k items x rank 64 synthetic factors; measures (a) the
@@ -3599,6 +3776,10 @@ def main():
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_elastic, u, i, r, n_users, n_items)
         return
+    if "--only-tiered" in sys.argv:
+        u, i, r, n_users, n_items = synthetic_ml100k()
+        section(bench_tiered, u, i, r, n_users, n_items)
+        return
     if "--only-serving" in sys.argv:
         u, i, r, n_users, n_items = synthetic_ml100k()
         section(bench_serving, u, i, r, n_users, n_items)
@@ -3637,6 +3818,7 @@ def main():
         section(bench_tenancy, u, i, r, n_users, n_items)
         section(bench_fleet, u, i, r, n_users, n_items)
         section(bench_fleet_crosshost, u, i, r, n_users, n_items)
+        section(bench_tiered, u, i, r, n_users, n_items)
         section(bench_ecommerce_scale)
         section(bench_multichip_serving)
         section(bench_serving_large_catalog)

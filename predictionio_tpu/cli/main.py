@@ -171,10 +171,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "reports in heartbeats (the autoscaler "
                         "addresses scaled children by it)")
     x.add_argument("--mesh",
-                   help="serving mesh spec (e.g. items=8): forces the "
+                   help="serving mesh spec. `items=8` forces the "
                         "mesh-sharded serve plan — item factors "
                         "partitioned row-wise across the device mesh "
-                        "with on-device partial top-k + allgather merge")
+                        "with on-device partial top-k + allgather "
+                        "merge. `items=N@fleet` (with --replicas or "
+                        "remote --join members) runs a CROSS-HOST "
+                        "mesh: each fleet member owns catalog shard "
+                        "i of N and the router merge re-top-ks their "
+                        "partial results")
     x.add_argument("--refresh-interval", type=float, default=0.0,
                    help="streaming freshness: seconds between "
                         "background delta-scan + fold-in + hot-swap "

@@ -324,6 +324,40 @@ def device_resident(arr):
     return dev
 
 
+# Live serving plans with device-pinned factor state, weakly held: the
+# capacity checks in ops/topk_sharded subtract these bytes (the
+# pio_plan_resident_bytes the server samples) before deciding whether a
+# NEW catalog still fits one device — without the subtraction,
+# back-to-back /reloads of a near-capacity catalog pass the fits check
+# against an EMPTY device and OOM once both plans are resident (the old
+# deployment stays pinned until the atomic swap completes).
+_RESIDENT_PLANS: "weakref.WeakSet" = None  # type: ignore[assignment]
+
+
+def register_resident_plan(plan) -> None:
+    """Track a plan whose factor state is device-resident. Weak
+    references only: a dropped deployment's plan leaves the accounting
+    as soon as it is garbage-collected."""
+    import weakref
+    global _RESIDENT_PLANS
+    if _RESIDENT_PLANS is None:
+        _RESIDENT_PLANS = weakref.WeakSet()
+    _RESIDENT_PLANS.add(plan)
+
+
+def plan_resident_bytes() -> float:
+    """Per-device bytes currently pinned by live serving plans."""
+    if _RESIDENT_PLANS is None:
+        return 0.0
+    total = 0.0
+    for plan in list(_RESIDENT_PLANS):
+        try:
+            total += float(plan.resident_per_device_bytes())
+        except Exception:   # noqa: BLE001 — accounting is best-effort
+            continue
+    return total
+
+
 def _topk_scores_banned(user_vecs, item_factors, banned, *,
                         k: int, has_bans: bool):
     scores = jnp.matmul(user_vecs, item_factors.T,
@@ -554,6 +588,12 @@ class BucketedTopK:
         # which bucket sizes went fused, so dispatch attribution can
         # tag "fused" vs "device" per call
         self._fused_sizes: set = set()
+        register_resident_plan(self)
+
+    def resident_per_device_bytes(self) -> float:
+        """Bytes this plan pins on ONE device (the whole factor block:
+        single-device plans are not sharded)."""
+        return float(self._host_factors.nbytes)
 
     def warm(self) -> int:
         """AOT-lower/compile every bucket executable; returns how many
@@ -681,6 +721,10 @@ class BucketedSimilar:
         self._host_factors = host
         self.factors = device_resident(host)
         self._exe: dict = {}
+        register_resident_plan(self)
+
+    def resident_per_device_bytes(self) -> float:
+        return float(self._host_factors.nbytes)
 
     def warm(self) -> int:
         """AOT-lower/compile every bucket executable (idempotent)."""

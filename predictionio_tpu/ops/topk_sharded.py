@@ -61,7 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from predictionio_tpu.ops import compat
+from predictionio_tpu.ops import compat, topk
 from predictionio_tpu.ops.topk import (
     DEFAULT_SERVE_BUCKETS, NEG_INF, BucketedSimilar, BucketedTopK,
     _next_pow2, _record_dispatch,
@@ -86,12 +86,53 @@ class ServeMesh:
         return int(self.mesh.shape[SHARD_AXIS])  # lint: ok — host meta
 
 
-def serve_mesh_from_conf(conf=None) -> Optional[ServeMesh]:
+@dataclass(frozen=True)
+class ShardSlice:
+    """A CROSS-HOST fleet shard assignment: this member owns one
+    contiguous row-slice of the catalog (shard `index` of `n_shards`,
+    same ceil-divided block partition the local sharded plans use).
+    Flows through `serve_plan`'s mesh slot, so the deploy warm path
+    builds a `ShardSliceTopK` instead of a whole-catalog plan."""
+    n_shards: int
+    index: int
+
+
+def parse_fleet_mesh(spec: str):
+    """Parse a cross-host mesh spec: `items=N@fleet` (router side:
+    merge over N member-owned shards) or `items=N@fleet:i` (member
+    side: this process owns shard i). Returns (n_shards, index-or-None)
+    or None when `spec` is not a fleet mesh."""
+    import re
+    m = re.match(r"\s*items\s*=\s*(\d+)\s*@\s*fleet(?::(\d+))?\s*$",
+                 spec or "")
+    if m is None:
+        return None
+    n = int(m.group(1))
+    idx = int(m.group(2)) if m.group(2) is not None else None
+    if n < 1 or (idx is not None and not 0 <= idx < n):
+        raise ValueError(f"bad fleet mesh spec {spec!r}: need "
+                         "items=N@fleet[:i] with 0 <= i < N")
+    return n, idx
+
+
+def serve_mesh_from_conf(conf=None):
     """The deploy-time serving mesh: the "items" axis over the local
     devices, or None when sharded serving is off or pointless (< 2
     devices). `conf` is the merged engine-instance + server
     runtime_conf; a configured training mesh there forces the sharded
-    path (training and serving agree on the device layout)."""
+    path (training and serving agree on the device layout). A
+    cross-host `items=N@fleet:i` mesh returns a `ShardSlice` instead —
+    this member serves only its owned catalog rows and the fleet
+    router merges across members."""
+    conf_mesh = str((conf or {}).get("mesh", "") or "")
+    fleet = parse_fleet_mesh(conf_mesh)
+    if fleet is not None:
+        n, idx = fleet
+        if idx is not None:
+            return ShardSlice(n_shards=n, index=idx)
+        # router-level spec: not a local device layout — never forces
+        # local sharding on the process that merges
+        conf_mesh = ""
     mode = (os.environ.get("PIO_SERVE_SHARD", "auto") or "auto").lower()
     if mode in ("off", "0", "false"):
         return None
@@ -101,7 +142,7 @@ def serve_mesh_from_conf(conf=None) -> Optional[ServeMesh]:
     n = min(want, len(devices)) if want > 0 else len(devices)
     if n < 2:
         return None
-    forced = mode in ("on", "1", "true") or bool((conf or {}).get("mesh"))
+    forced = mode in ("on", "1", "true") or bool(conf_mesh)
     return ServeMesh(Mesh(np.array(devices[:n]),  # lint: ok — host list
                           (SHARD_AXIS,)), forced)
 
@@ -122,45 +163,103 @@ def device_capacity_bytes() -> Optional[float]:
         return None
 
 
+def effective_device_capacity() -> Optional[float]:
+    """The byte budget a NEW plan may still pin on one device: raw
+    capacity with 20% headroom for score/workspace buffers, MINUS the
+    bytes live plans already hold resident (the server's
+    pio_plan_resident_bytes). Without the subtraction, back-to-back
+    /reloads of a near-capacity catalog pass the fits check against an
+    EMPTY device and OOM once old + new deployments are both pinned
+    (the old plan stays resident until the atomic swap completes)."""
+    cap = device_capacity_bytes()
+    if cap is None:
+        return None
+    return cap * 0.8 - topk.plan_resident_bytes()
+
+
 def _wants_shard(n_items: int, rank: int,
                  mesh: Optional[ServeMesh]) -> bool:
     """Whether `serve_plan` should build the sharded plan: a usable
     mesh AND (explicitly configured, or the factor matrix does not fit
     one device — `BucketedTopK.fits`-style capacity check, with 20%
-    headroom for the score/workspace buffers)."""
-    if mesh is None or mesh.n_shards < 2:
+    headroom and resident-plan bytes subtracted, see
+    `effective_device_capacity`)."""
+    if mesh is None or not isinstance(mesh, ServeMesh) \
+            or mesh.n_shards < 2:
         return False
     if mesh.forced:
         return True
-    cap = device_capacity_bytes()
+    cap = effective_device_capacity()
     if cap is None:
         return False
-    return n_items * rank * 4 > cap * 0.8
+    return n_items * rank * 4 > cap
+
+
+def _tier_hot_items(n_items: int, rank: int) -> Optional[int]:
+    """Hot-slab size when tiered storage should engage, else None.
+    `PIO_SERVE_TIER=on` always tiers; `auto` (default) tiers only when
+    the factor matrix exceeds the effective device budget; `off`
+    never. `PIO_TIER_HOT_FRAC` sizes the slab explicitly; unset, the
+    slab fills the effective budget (quarter-catalog fallback when the
+    budget is unknown but tiering is forced on)."""
+    from predictionio_tpu.ops import topk_tiered
+    mode = topk_tiered.tier_mode()
+    if mode == "off":
+        return None
+    cap = effective_device_capacity()
+    nbytes = n_items * rank * 4
+    if mode == "auto" and (cap is None or nbytes <= cap):
+        return None
+    frac = topk_tiered.hot_frac()
+    if frac is not None:
+        hot = int(n_items * frac)
+    elif cap is not None and cap > 0:
+        hot = int(cap // (rank * 4))
+    else:
+        hot = n_items // 4
+    return max(1, min(hot, n_items))
 
 
 def serve_plan(item_factors, *, k: int,
                buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
                banned_width: int = 256,
-               mesh: Optional[ServeMesh] = None):
-    """The banned-index serving plan for this deployment: sharded when
-    the mesh warrants it (see `_wants_shard`), else the single-device
-    `BucketedTopK`. Both satisfy the same warm/fits/__call__ contract."""
+               mesh=None):
+    """The banned-index serving plan for this deployment. Selection
+    order: a cross-host `ShardSlice` builds the member-local slice plan
+    (whose inner plan recurses through this selection — a giant shard
+    slice tiers itself); a local mesh that warrants it shards
+    (`_wants_shard`); a catalog past the effective device budget tiers
+    (`_tier_hot_items` / PIO_SERVE_TIER); else the single-device
+    `BucketedTopK`. All satisfy the same warm/fits/__call__ contract."""
     n_items, rank = np.asarray(item_factors).shape  # lint: ok — host meta
+    if isinstance(mesh, ShardSlice):
+        return ShardSliceTopK(item_factors, k=k, buckets=buckets,
+                              banned_width=banned_width, slice_spec=mesh)
     if _wants_shard(n_items, rank, mesh):
         return ShardedBucketedTopK(item_factors, k=k, buckets=buckets,
                                    banned_width=banned_width,
                                    mesh=mesh.mesh)
+    hot = _tier_hot_items(n_items, rank)
+    if hot is not None:
+        from predictionio_tpu.ops.topk_tiered import TieredTopK
+        return TieredTopK(item_factors, k=k, buckets=buckets,
+                          banned_width=banned_width, hot_items=hot)
     return BucketedTopK(item_factors, k=k, buckets=buckets,
                         banned_width=banned_width)
 
 
 def similar_plan(item_factors, *, k: int,
                  buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
-                 mesh: Optional[ServeMesh] = None):
+                 mesh=None):
     """The dense-mask cosine serving plan: sharded or single-device by
-    the same selection rule as `serve_plan`."""
+    the same selection rule as `serve_plan`. A cross-host `ShardSlice`
+    keeps the single-device plan over the FULL catalog (the dense-mask
+    path has no slice variant); every member then returns identical
+    similar-items candidates and the router merge deduplicates — exact,
+    just not memory-partitioned."""
     n_items, rank = np.asarray(item_factors).shape  # lint: ok — host meta
-    if _wants_shard(n_items, rank, mesh):
+    if not isinstance(mesh, ShardSlice) and _wants_shard(n_items, rank,
+                                                         mesh):
         return ShardedBucketedSimilar(item_factors, k=k, buckets=buckets,
                                       mesh=mesh.mesh)
     return BucketedSimilar(item_factors, k=k, buckets=buckets)
@@ -209,7 +308,12 @@ class _ShardedPlanBase:
         # sees >= k real candidates overall)
         self.k_shard = min(self.k, self.per_shard)
         self._exe: dict = {}
+        topk.register_resident_plan(self)
         _publish_shard_gauges(self.n_shards, self.per_shard, self.rank)
+
+    def resident_per_device_bytes(self) -> float:
+        """Bytes this plan pins per device: one padded shard's rows."""
+        return float(self.per_shard * self.rank * 4)
 
     def swap_factors(self, item_factors) -> np.ndarray:
         """Hot-swap the sharded resident factors (streaming refresher
@@ -478,3 +582,97 @@ class ShardedBucketedSimilar(_ShardedPlanBase):
         _record_dispatch("sharded", bucket * self.n_items,
                          time.perf_counter() - t0)
         return scores[:b], ixs[:b]
+
+
+class ShardSliceTopK:
+    """The cross-host MEMBER-side plan: this process owns one
+    contiguous ceil-divided row block of the catalog and serves
+    shard-local candidates in GLOBAL id space; the fleet router merges
+    candidates across members (shard-major, (-score, global id)
+    tie-break — bit-identical to the single-device oracle by the same
+    survival argument as the local sharded merge).
+
+    The inner plan over the slice recurses through `serve_plan` with no
+    mesh, so a slice that still exceeds the member's device budget
+    tiers itself (`TieredTopK`) — the composition the giant-catalog
+    path needs. Banned ids arrive untranslated (global); out-of-slice
+    ids are dropped host-side before the inner plan sees them, so a
+    boundary-straddling ban can neither leak nor alias a neighbor."""
+
+    def __init__(self, item_factors, *, k: int,
+                 buckets: Sequence[int] = DEFAULT_SERVE_BUCKETS,
+                 banned_width: int = 256, slice_spec: ShardSlice = None):
+        full = np.ascontiguousarray(item_factors, dtype=np.float32)  # lint: ok — host copy
+        n_total, rank = full.shape
+        n = int(slice_spec.n_shards)
+        idx = int(slice_spec.index)
+        per = -(-n_total // n)        # ceil: same block partition as
+        self.base = min(per * idx, n_total)   # the local sharded plans
+        self._hi = min(self.base + per, n_total)
+        if self._hi <= self.base:
+            raise ValueError(
+                f"fleet shard {idx}/{n} is empty for {n_total} items — "
+                "lower the shard count")
+        self.slice_spec = slice_spec
+        self.n_items = n_total        # global catalog size
+        self.rank = rank
+        self.slice_items = self._hi - self.base
+        self.k = max(1, min(k, n_total))
+        self.banned_width = banned_width
+        self._inner = serve_plan(full[self.base:self._hi], k=k,
+                                 buckets=buckets,
+                                 banned_width=banned_width, mesh=None)
+
+    # -- plan contract (delegates) ------------------------------------------
+    @property
+    def factors(self):
+        return self._inner.factors
+
+    @property
+    def buckets(self):
+        return self._inner.buckets
+
+    @property
+    def max_bucket(self) -> int:
+        return self._inner.max_bucket
+
+    def resident_per_device_bytes(self) -> float:
+        # the inner plan registered itself; avoid double-counting
+        return 0.0
+
+    def warm(self) -> int:
+        return self._inner.warm()
+
+    def fits(self, *, max_banned: int, k: int) -> bool:
+        # k above the slice's own candidate count still FITS: the
+        # member legitimately contributes min(k, slice_items)
+        # candidates and the router merge fills from other shards — a
+        # fallback to the generic full-catalog path here would leak
+        # out-of-slice items and duplicate candidates across members
+        return (k <= self.k and max_banned <= self.banned_width
+                and self._inner.fits(
+                    max_banned=max_banned,
+                    k=min(k, getattr(self._inner, "k", k))))
+
+    def swap_factors(self, item_factors) -> np.ndarray:
+        """Hot swap: accepts the FULL new catalog (streaming refresher)
+        or a slice-shaped block (rollback token replay)."""
+        host = np.ascontiguousarray(item_factors, dtype=np.float32)  # lint: ok — host copy
+        if host.shape == (self.n_items, self.rank):
+            return self._inner.swap_factors(host[self.base:self._hi])
+        return self._inner.swap_factors(host)
+
+    def __call__(self, user_vecs, banned_lists: Sequence[Sequence[int]]):
+        """Shard-local top-k in global id space: returns (scores
+        [b, k_local], GLOBAL ids [b, k_local]) for this member's rows
+        only."""
+        local = []
+        for bl in banned_lists:
+            if len(bl):
+                arr = np.asarray(bl, np.int64)  # lint: ok — host ids
+                arr = arr[(arr >= self.base) & (arr < self._hi)]
+                local.append((arr - self.base).tolist())
+            else:
+                local.append(())
+        scores, ixs = self._inner(user_vecs, local)
+        return scores, ixs + np.int32(self.base)

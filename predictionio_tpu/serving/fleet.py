@@ -81,7 +81,9 @@ from predictionio_tpu.serving.server import PredictionServer, ServerConfig
 from predictionio_tpu.utils.http import (
     HTTPError, HTTPServerBase, Request, Response,
 )
-from predictionio_tpu.utils.wire import HTTPConnectionPool
+from predictionio_tpu.utils.wire import (
+    BIN_CONTENT_TYPE, HTTPConnectionPool, decode_bin_query,
+)
 
 _log = get_logger("serving.fleet")
 
@@ -96,6 +98,27 @@ _FORWARD_HEADERS = ("X-PIO-Deadline-Ms", "X-Request-ID", "Authorization",
 # fsck's divergence sweep reports but never deletes unknown ids, so the
 # blob is safe alongside real model envelopes
 _MEMBERS_BLOB_PREFIX = "__fleet_members__"
+
+
+def measure_store_rtt(leases, holder: str, samples: int = 3) -> float:
+    """Median CAS round-trip of the lease store, measured with a
+    throwaway probe lease. The lease TTL and heartbeat cadence are only
+    meaningful when they dwarf this RTT — a TTL within a few RTTs of
+    the store flaps leadership on every storage hiccup."""
+    name = f"__rtt_probe__{holder or 'fleet'}"
+    times = []
+    for _ in range(max(1, samples)):
+        t0 = time.perf_counter()
+        try:
+            leases.acquire(name, holder, 1.0)
+            leases.release(name, holder)
+        except Exception:
+            continue              # a failed probe measures nothing
+        times.append(time.perf_counter() - t0)
+    if not times:
+        return 0.0
+    times.sort()
+    return times[len(times) // 2]
 
 
 @dataclass
@@ -172,6 +195,7 @@ class _Replica:
         self.ejected_at = 0.0     # monotonic stamp of last eject evidence
         self.model_id = ""
         self.name = ""            # supervisor child name, from heartbeats
+        self.shard = ""           # mesh shard owned ("i/n"), "" = whole
 
     @property
     def key(self) -> str:
@@ -205,6 +229,7 @@ class _Replica:
                     "state": self.state, "admitted": self.admitted,
                     "failures": self.failures, "inflight": self.inflight,
                     "model": self.model_id, "name": self.name,
+                    "shard": self.shard,
                     "beat_age_s": round(time.monotonic() - self.last_beat, 3)}
 
 
@@ -223,6 +248,16 @@ class FleetServer(HTTPServerBase):
 
         self.config = config
         self.fleet = fleet if fleet is not None else FleetConfig()
+        # cross-host serve mesh: `--mesh items=N@fleet` makes this
+        # router a MERGE point over N member-owned catalog shards
+        # (in-process replicas are auto-assigned shard i%N; remote
+        # members declare theirs via heartbeats). 0 = plain routing.
+        from predictionio_tpu.ops.topk_sharded import parse_fleet_mesh
+        parsed = parse_fleet_mesh(config.mesh)
+        self._mesh_shards = (parsed[0]
+                             if parsed is not None and parsed[1] is None
+                             else 0)
+        self.store_rtt_s = 0.0    # measured at start by _apply_rtt_floor
         if self.fleet.replicas < 0:
             raise ValueError(
                 "replicas must be >= 0 (0 = router-only: --join feeds "
@@ -329,10 +364,17 @@ class FleetServer(HTTPServerBase):
         admission = getattr(self, "admission", None)
         tenancy = (admission.config.replica_variant()
                    if admission is not None else None)
+        # mesh mode: each in-process replica owns catalog shard i%N —
+        # its warm_deploy sees `items=N@fleet:i` and builds a
+        # ShardSliceTopK over its slice only
+        mesh = self.config.mesh
+        shards = getattr(self, "_mesh_shards", 0)
+        if shards:
+            mesh = f"items={shards}@fleet:{index % shards}"
         return dataclasses.replace(
             self.config, ip="127.0.0.1", port=0, startup_check=False,
             max_inflight=0, refresh_stagger_s=stagger,
-            tenancy=tenancy)
+            tenancy=tenancy, mesh=mesh)
 
     def start(self, background: bool = True) -> int:
         for i in range(self.fleet.replicas):
@@ -342,6 +384,7 @@ class FleetServer(HTTPServerBase):
                 metrics=self.metrics)
             rep = _Replica(i, server)
             rep.port = server.start(background=True)
+            rep.shard = server.shard_spec()
             self._replicas.append(rep)
             if self._probe(rep):
                 rep.beat()
@@ -355,6 +398,7 @@ class FleetServer(HTTPServerBase):
             self._advertise = f"127.0.0.1:{port}"
         self._holder = self._advertise
         self._resolve_leases()
+        self._apply_rtt_floor()
         self._restore_members()
         # leadership settles before start() returns: a fresh single
         # router is leader immediately; a standby next to a live leader
@@ -478,6 +522,40 @@ class FleetServer(HTTPServerBase):
             # only if the operator runs two routers anyway)
             self._leases = None
             _log.warning("lease_dao_unavailable_always_leader", error=str(e))
+
+    def _apply_rtt_floor(self) -> None:
+        """Satellite guard: measure the lease store's CAS RTT once at
+        start and CLAMP the lease TTL (and heartbeat cadence) to at
+        least 10x it. An operator-tuned PIO_FLEET_LEASE_TTL_S that the
+        store cannot physically renew in time would otherwise flap
+        leadership on every slow CAS — warn loudly instead of flapping
+        silently."""
+        if self._leases is None:
+            return
+        rtt = measure_store_rtt(self._leases, self._holder)
+        self.store_rtt_s = rtt
+        self.metrics.gauge(
+            "pio_fleet_store_rtt_seconds",
+            "Median lease-store CAS round-trip measured at start").set(rtt)
+        if rtt <= 0:
+            return
+        floor = 10.0 * rtt
+        if self.fleet.lease_ttl_s < floor:
+            _log.warning(
+                "lease_ttl_below_rtt_floor_clamped",
+                configured_ttl_s=self.fleet.lease_ttl_s,
+                store_rtt_s=round(rtt, 4),
+                clamped_ttl_s=round(floor, 3),
+                hint="PIO_FLEET_LEASE_TTL_S must be >= 10x the lease "
+                     "store's CAS RTT or leadership flaps on slow CAS")
+            self.fleet.lease_ttl_s = floor
+        hb_floor = floor / 3.0
+        if 0 < self.fleet.heartbeat_s < hb_floor:
+            _log.warning(
+                "heartbeat_below_rtt_floor_clamped",
+                configured_heartbeat_s=self.fleet.heartbeat_s,
+                clamped_heartbeat_s=round(hb_floor, 3))
+            self.fleet.heartbeat_s = hb_floor
 
     def _lease_tick(self) -> None:
         if self._leases is None:
@@ -655,7 +733,8 @@ class FleetServer(HTTPServerBase):
         """Snapshot the remote membership into the model store, so a
         restarted router re-admits remote replicas immediately instead
         of waiting a full re-registration interval."""
-        remote = [{"member": r.key, "model": r.model_id}
+        remote = [{"member": r.key, "model": r.model_id,
+                   "shard": r.shard}
                   for r in list(self._replicas) if r.remote]
         try:
             self.ctx.registry.get_model_data_models().insert(Model(
@@ -688,6 +767,7 @@ class FleetServer(HTTPServerBase):
                 continue
             rep = self._add_member(host, int(port_s))  # lint: ok — host str
             rep.model_id = str(entry.get("model", ""))
+            rep.shard = str(entry.get("shard", ""))
             if self._probe(rep):
                 rep.beat()
                 self._admit(rep)
@@ -721,6 +801,9 @@ class FleetServer(HTTPServerBase):
             name = str(body.get("name", ""))
             if name:
                 rep.name = name   # supervisor child name, for retirement
+            shard = str(body.get("shard", ""))
+            if shard != rep.shard:
+                rep.shard = shard  # mesh shard this member declares
             # retiring members stay out of rotation but keep beating:
             # a drain-in-progress must not re-admit (nor eject) itself
             busy = rep.state in ("reloading", "stopping", "retiring")
@@ -733,7 +816,7 @@ class FleetServer(HTTPServerBase):
                 self._eject(rep, "member reported not ready")
         return Response.json({
             "member": member, "admitted": rep.admitted,
-            "leader": self._leader_hint,
+            "leader": self._leader_hint, "shard": rep.shard,
             "heartbeat_s": self.fleet.effective_heartbeat_s()})
 
     # -- health gating ------------------------------------------------------
@@ -843,6 +926,11 @@ class FleetServer(HTTPServerBase):
         self._fleet_obs["admitted"].set(float(admitted))  # lint: ok — host int
         self._fleet_obs["size"].set(float(len(members)))
         self._fleet_obs["members"].set(float(len(members)))
+        for rep in members:
+            if rep.shard:
+                self._fleet_obs["shard_owner"].labels(
+                    shard=rep.shard, member=rep.key).set(
+                        1.0 if rep.admitted else 0.0)
 
     # -- elastic scale-down (drain != death) --------------------------------
     def member_by_name(self, name: str) -> Optional[_Replica]:
@@ -1027,6 +1115,28 @@ class FleetServer(HTTPServerBase):
             status=status, body=body,
             content_type=rheaders.get("Content-Type", "application/json"))
 
+    def _leader_gate(self, req: Request, p) -> None:
+        """Non-leaders 307-redirect data traffic to the leader (503
+        when no leader is elected yet) — shared by the plain route and
+        the mesh merge path."""
+        if self._is_leader:
+            return
+        leader = self._leader_hint
+        if leader and leader != self._advertise:
+            self._fleet_obs["routed"].labels(outcome="redirected").inc()
+            hdrs = {"Location": f"http://{leader}{req.path}"}
+            if p is not None:
+                # attach our trace context to the redirect so a
+                # trace-aware client re-asserts it at the leader and
+                # the two hops stitch under one trace id
+                trace.annotate_pending(p, kind="router")
+                hdrs[trace.TRACE_HEADER] = trace.child_header(p)
+            raise HTTPError(
+                307, f"not the fleet leader; try {leader}",
+                headers=hdrs)
+        raise HTTPError(503, "no fleet leader elected",
+                        headers={"Retry-After": "1"})
+
     def _route(self, req: Request,
                extra_headers: Optional[Dict[str, str]] = None) -> Response:
         """Route to an admitted member; connection-level failures are
@@ -1034,22 +1144,7 @@ class FleetServer(HTTPServerBase):
         requests when a member dies), each failure feeding the
         ejection counter. Non-leaders redirect to the leader."""
         p = trace.current()
-        if not self._is_leader:
-            leader = self._leader_hint
-            if leader and leader != self._advertise:
-                self._fleet_obs["routed"].labels(outcome="redirected").inc()
-                hdrs = {"Location": f"http://{leader}{req.path}"}
-                if p is not None:
-                    # attach our trace context to the redirect so a
-                    # trace-aware client re-asserts it at the leader and
-                    # the two hops stitch under one trace id
-                    trace.annotate_pending(p, kind="router")
-                    hdrs[trace.TRACE_HEADER] = trace.child_header(p)
-                raise HTTPError(
-                    307, f"not the fleet leader; try {leader}",
-                    headers=hdrs)
-            raise HTTPError(503, "no fleet leader elected",
-                            headers={"Retry-After": "1"})
+        self._leader_gate(req, p)
         deadline = current_deadline()
         rotation = self._rotation()
         if not rotation:
@@ -1106,6 +1201,148 @@ class FleetServer(HTTPServerBase):
             f"every admitted replica unreachable "
             f"(last: {type(last_err).__name__ if last_err else 'n/a'})",
             headers={"Retry-After": "1"})
+
+    def _route_mesh(self, req: Request,
+                    extra_headers: Optional[Dict[str, str]] = None
+                    ) -> Response:
+        """Cross-host mesh merge: fan one query out to an admitted
+        owner of EVERY catalog shard (`/shard/queries.json`, same
+        persistent upstream pool), then re-top-k the returned (global
+        id, score) candidates by (-score, gid) with gid dedupe —
+        bit-identical to the single-device oracle whenever all shards
+        answer. Transport failures retry the NEXT owner of the SAME
+        shard (feeding the ejection counter); a shard with no live
+        owner degrades the response (`partial: true`, the remaining
+        shards still serve) — a missing member never costs the client
+        a 500."""
+        p = trace.current()
+        self._leader_gate(req, p)
+        deadline = current_deadline()
+        n = self._mesh_shards
+        shards = [f"{i}/{n}" for i in range(n)]
+        owners: Dict[str, List[_Replica]] = {s: [] for s in shards}
+        for rep in self._replicas:
+            if rep.admitted and rep.shard in owners:
+                owners[rep.shard].append(rep)
+        if not any(owners.values()):
+            # no member declares a shard (mixed/older fleet): the
+            # mesh degrades to plain routing rather than 503ing
+            return self._route(req, extra_headers=extra_headers)
+        headers = {}
+        for name in _FORWARD_HEADERS:
+            v = req.header(name)
+            if v:
+                headers[name] = v
+        if extra_headers:
+            headers.update(extra_headers)
+        body = req.body
+        if (headers.get("Content-Type") or "").startswith(
+                BIN_CONTENT_TYPE):
+            # binary-framed wire queries decode HERE: members' shard
+            # surface speaks JSON, and the frame only carries
+            # (user, num) anyway
+            decoded = decode_bin_query(body)
+            if decoded is None:
+                raise HTTPError(400, "malformed binary query frame")
+            body = json.dumps({"user": decoded[0],
+                               "num": decoded[1]}).encode()
+            headers["Content-Type"] = "application/json"
+        cands: List[tuple] = []
+        num = 0
+        degraded: List[str] = []
+        for shard in shards:
+            got = None
+            for rep in owners[shard]:
+                timeout = self.fleet.proxy_timeout_s
+                if deadline is not None:
+                    remaining = deadline.remaining()
+                    if remaining <= 0.005:
+                        self._shed_counter.labels(surface="deadline",
+                                                  app="").inc()
+                        raise DeadlineExceeded(
+                            "deadline budget exhausted before dialing "
+                            "a shard owner")
+                    timeout = min(timeout, remaining)
+                with rep.lock:
+                    rep.inflight += 1
+                t_dial = time.perf_counter()
+                try:
+                    if faults().dropped(f"fleet.net.{rep.key}.data"):
+                        raise OSError(
+                            f"injected partition: fleet.net.{rep.key}.data")
+                    status, rheaders, rbody = self._upstream.request(
+                        rep.host, rep.port, "POST",
+                        "/shard/queries.json", body, headers, timeout)
+                except OSError as e:
+                    trace.add_span(p, f"shard_retry:{rep.key}", t_dial,
+                                   time.perf_counter())
+                    self._record_failure(
+                        rep, f"shard route error: {type(e).__name__}: {e}",
+                        data_path=True)
+                    self._fleet_obs["routed"].labels(
+                        outcome="retried").inc()
+                    continue
+                finally:
+                    with rep.lock:
+                        rep.inflight -= 1
+                trace.add_span(p, f"shard:{shard}:{rep.key}", t_dial,
+                               time.perf_counter())
+                if status >= 500:
+                    self._record_failure(rep, f"HTTP {status}",
+                                         data_path=True)
+                    continue
+                if status >= 400:
+                    # a CLIENT error (bad query, over quota): every
+                    # shard would answer identically — pass it through
+                    return Response(
+                        status=status, body=rbody,
+                        content_type=rheaders.get("Content-Type",
+                                                  "application/json"))
+                with rep.lock:
+                    rep.failures = 0
+                try:
+                    got = json.loads(rbody)
+                except ValueError:
+                    self._record_failure(rep, "unparseable shard reply",
+                                         data_path=True)
+                    got = None
+                    continue
+                break
+            if got is None:
+                degraded.append(shard)
+                continue
+            num = max(num, int(got.get("num") or 0))  # lint: ok — host json
+            for c in got.get("cands", ()):
+                cands.append((int(c[0]), float(c[1]), c[2]))  # lint: ok — host json
+        if not cands:
+            self._fleet_obs["mesh"].labels(outcome="empty").inc()
+            self._fleet_obs["routed"].labels(outcome="exhausted").inc()
+            raise HTTPError(
+                503, f"no mesh shard reachable ({len(degraded)}/{n} "
+                     "degraded)", headers={"Retry-After": "1"})
+        # exact merge re-top-k: stable (-score, global id) — the same
+        # tie-break every plan layer uses — then gid dedupe, which also
+        # collapses full-catalog answers from shard-less members
+        cands.sort(key=lambda c: (-c[1], c[0]))
+        seen = set()
+        top: List[dict] = []
+        for gid, score, name in cands:
+            key = gid if gid >= 0 else f"name:{name}"
+            if key in seen:
+                continue
+            seen.add(key)
+            top.append({"item": name, "score": score})
+            if num and len(top) >= num:
+                break
+        out: Dict[str, object] = {"itemScores": top}
+        if degraded:
+            out["partial"] = True
+            out["degradedShards"] = degraded
+            self._fleet_obs["mesh"].labels(outcome="partial").inc()
+        else:
+            self._fleet_obs["mesh"].labels(outcome="ok").inc()
+        self._fleet_obs["routed"].labels(outcome="ok").inc()
+        return Response.json(out)
 
     # -- rolling reload -----------------------------------------------------
     def _await_drain(self, rep: _Replica) -> bool:
@@ -1308,6 +1545,8 @@ class FleetServer(HTTPServerBase):
                         app=tenant.label if tenant is not None else "")
                     extra = dict(extra or ())
                     extra[trace.TRACE_HEADER] = trace.child_header(p)
+                if self._mesh_shards:
+                    return self._route_mesh(req, extra_headers=extra)
                 return self._route(req, extra_headers=extra)
 
         @r.post("/fleet/register")
@@ -1465,9 +1704,13 @@ class ReplicaAgent:
             ready, _ = self.server.readiness()
         except Exception:
             ready = False
+        # shard_spec is PredictionServer-only; stub replicas (the
+        # supervisor's test double) and older server shapes have none
+        shard = getattr(self.server, "shard_spec", lambda: "")()
         return json.dumps({"member": self.advertise,
                            "model": self.server.current_instance_id(),
                            "name": self.member_name,
+                           "shard": shard,
                            "ready": bool(ready)}).encode()
 
     def _post(self, url: str, data: bytes) -> dict:
@@ -1641,4 +1884,12 @@ def _fleet_metrics(metrics: MetricsRegistry):
             "pio_fleet_heartbeat_age_seconds",
             "Seconds since each member's last heartbeat or healthy probe",
             labels=("member",)),
+        "shard_owner": metrics.gauge(
+            "pio_fleet_shard_owner",
+            "Mesh shard ownership (1 = admitted owner of the shard)",
+            labels=("shard", "member")),
+        "mesh": metrics.counter(
+            "pio_fleet_mesh_merged_total",
+            "Cross-host mesh merges by outcome (ok/partial/empty)",
+            labels=("outcome",)),
     }

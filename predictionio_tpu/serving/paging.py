@@ -1,0 +1,142 @@
+"""Async demand-paging for tiered serving plans (ops/topk_tiered).
+
+One `PageManager` per PredictionServer: a single background thread
+(`pio-tier-pager`, watchdog-registered) that, every tick, folds the
+serve path's access buffers into per-item EWMAs and runs one batched
+promotion/eviction pass per tiered plan. Everything expensive —
+bincount fold, argpartition, slab gather, host->device upload — happens
+HERE, off the serve path; the serve path only appends served-id arrays
+to a buffer (GIL-atomic) and takes one uncontended lock per call.
+
+Publishes the tier metrics: `pio_tier_hot_items`, `pio_tier_hit_ratio`,
+`pio_tier_promotions_total`, `pio_tier_page_seconds` (histogram of the
+slab rebuild+upload wall time).
+
+Knobs: `PIO_TIER_PAGE_INTERVAL_S` (default 1.0), hysteresis and
+minimum-batch come from the constructor (serving defaults are fine —
+the hysteresis retention bonus keeps near-ties from thrashing).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from predictionio_tpu.obs import get_logger, get_registry
+
+_log = get_logger("paging")
+
+
+def page_interval_s() -> float:
+    try:
+        return max(0.01, float(  # lint: ok — env str
+            os.environ.get("PIO_TIER_PAGE_INTERVAL_S", "1.0") or 1.0))
+    except ValueError:
+        return 1.0
+
+
+class PageManager:
+    """The async page thread over a server's tiered plans."""
+
+    def __init__(self, interval_s: Optional[float] = None,
+                 hysteresis: float = 0.25, min_swap: int = 1,
+                 metrics=None):
+        self.interval_s = (interval_s if interval_s is not None
+                           else page_interval_s())
+        self.hysteresis = hysteresis
+        self.min_swap = min_swap
+        self._plans: List = []
+        self._plans_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beat = None          # watchdog liveness stamp
+        reg = metrics if metrics is not None else get_registry()
+        self._hot_items = reg.gauge(
+            "pio_tier_hot_items",
+            "Device-resident hot-slab size of each tiered plan",
+            labels=("plan",))
+        self._hit_ratio = reg.gauge(
+            "pio_tier_hit_ratio",
+            "Fraction of served top-k entries answered by the hot slab",
+            labels=("plan",))
+        self._promotions = reg.counter(
+            "pio_tier_promotions_total",
+            "Items promoted into the hot slab by the page thread",
+            labels=("plan",))
+        self._page_seconds = reg.histogram(
+            "pio_tier_page_seconds",
+            "Wall time of one batched slab promotion pass")
+
+    # -- lifecycle ----------------------------------------------------------
+    def bind(self, plans) -> None:
+        """Replace the tracked tiered plans (deploy / reload swap)."""
+        with self._plans_lock:
+            self._plans = list(plans)
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        if self.beat is None:
+            from predictionio_tpu.resilience.watchdog import watchdog
+            # a dead pager means the hot set stops adapting (hit ratio
+            # decays, never corruption): restartable, generous budget
+            self.beat = watchdog().register(
+                "tier-pager", budget_s=self.interval_s * 5.0 + 5.0,
+                restart=self._spawn)
+        self._spawn()
+
+    def _spawn(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="pio-tier-pager", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        beat, self.beat = self.beat, None
+        if beat is not None:
+            beat.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- the page loop ------------------------------------------------------
+    def _loop(self) -> None:
+        beat = self.beat
+        if beat is not None:
+            beat.guard(self._loop_body)
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
+        beat = self.beat
+        while not self._stop.wait(self.interval_s):
+            if beat is not None:
+                beat.tick()
+            self.tick()
+
+    def tick(self) -> int:
+        """One fold+rebalance pass over every bound plan; returns total
+        promotions (exposed for tests and the bench, which drive paging
+        deterministically instead of racing the interval)."""
+        with self._plans_lock:
+            plans = list(self._plans)
+        promoted_total = 0
+        for i, plan in enumerate(plans):
+            label = str(i)
+            try:
+                plan.fold_accesses()
+                promoted = plan.rebalance(hysteresis=self.hysteresis,
+                                          min_swap=self.min_swap)
+            except Exception as e:   # noqa: BLE001 — paging must not die
+                _log.warning("tier_page_failed", plan=label,
+                             error=f"{type(e).__name__}: {e}")
+                continue
+            if promoted:
+                promoted_total += promoted
+                self._promotions.labels(plan=label).inc(promoted)
+                self._page_seconds.observe(plan.last_page_seconds)
+            self._hot_items.labels(plan=label).set(float(plan.hot_items))
+            self._hit_ratio.labels(plan=label).set(plan.hit_ratio())
+        return promoted_total

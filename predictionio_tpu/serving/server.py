@@ -393,6 +393,11 @@ class _Deployment:
         self.user_maps = tuple(
             um for um in (getattr(m, "users", None) for m in models)
             if um is not None and hasattr(um, "get"))
+        # item name -> global id maps, consulted by the mesh shard route
+        # to return GLOBAL ids the router can merge and dedupe on
+        self.item_maps = tuple(
+            im for im in (getattr(m, "items", None) for m in models)
+            if im is not None and hasattr(im, "get"))
 
     def predict_batch(self, queries: Sequence[Any]) -> List[Any]:
         """supplement -> per-algo batch_predict -> serve, for a batch;
@@ -957,6 +962,7 @@ class PredictionServer(HTTPServerBase):
             min_overlap=config.canary_min_overlap,
             metrics=self.metrics) if q_on else None)
         self._joiner = None
+        self._pager = None
         # warm-start the topk dispatch policy from the last run's learned
         # host/device crossover before any serve traffic arrives
         self._restore_dispatch_state()
@@ -1092,6 +1098,7 @@ class PredictionServer(HTTPServerBase):
         with self._dep_lock:
             self._dep = new_dep
         self._serve_obs.reloads.labels(outcome="ok").inc()
+        self._sync_pager(new_dep)
         # each successful (re)load starts a fresh drift reference
         # window: the new model's own scores are the new baseline
         if self._quality is not None:
@@ -1099,6 +1106,36 @@ class PredictionServer(HTTPServerBase):
         # checkpoint the learned dispatch EWMAs on every successful
         # (re)load, so the NEXT process start resumes warm
         self._save_dispatch_state()
+
+    @staticmethod
+    def _tiered_plans(dep: _Deployment):
+        """The deployment's tiered (demand-paged) serving plans, if
+        any — unwrapping one mesh-slice layer, where a giant slice
+        tiers itself."""
+        out = []
+        for holder in list(dep.algos) + list(dep.models):
+            plan = getattr(holder, "_serve_plan", None)
+            plan = getattr(plan, "_inner", plan)
+            if plan is not None and hasattr(plan, "fold_accesses") \
+                    and plan not in out:
+                out.append(plan)
+        return out
+
+    def _sync_pager(self, dep: _Deployment) -> None:
+        """Bind the async page thread to the deployment's tiered
+        plans: started on first sight, rebound across /reload (the
+        new plans' access stats start cold), retired when a reload
+        drops tiering entirely."""
+        plans = self._tiered_plans(dep)
+        if plans:
+            if self._pager is None:
+                from predictionio_tpu.serving.paging import PageManager
+                self._pager = PageManager(metrics=self.metrics)
+            self._pager.bind(plans)
+            self._pager.start()
+        elif self._pager is not None:
+            pager, self._pager = self._pager, None
+            pager.stop()
 
     def _canary_replay(self, dep: _Deployment,
                        qdicts: List[Dict]) -> List[Any]:
@@ -1202,6 +1239,8 @@ class PredictionServer(HTTPServerBase):
             beats.append(self._fsck_sched.beat)
         if self._batcher is not None:
             beats.append(self._batcher._drain_beat)
+        if self._pager is not None:
+            beats.append(self._pager.beat)
         beats.append(self._feedback_beat)
         scraper = self._scraper
         if scraper is not None:
@@ -1233,6 +1272,20 @@ class PredictionServer(HTTPServerBase):
             detail["memPressure"] = self._pressure.detail()
             return (False, detail)
         return (loaded and not open_breakers and not degraded, detail)
+
+    def shard_spec(self) -> str:
+        """`"i/n"` when this server was deployed as cross-host mesh
+        shard i of n (`--mesh items=N@fleet:i`), else "" — advertised
+        by the replica agent's heartbeats so the fleet router can map
+        shard ownership without extra control traffic."""
+        from predictionio_tpu.ops.topk_sharded import parse_fleet_mesh
+        try:
+            parsed = parse_fleet_mesh(self.config.mesh)
+        except ValueError:
+            return ""
+        if parsed is None or parsed[1] is None:
+            return ""
+        return f"{parsed[1]}/{parsed[0]}"
 
     def current_instance_id(self) -> str:
         """Engine-instance id of the deployment currently serving, ""
@@ -1310,6 +1363,8 @@ class PredictionServer(HTTPServerBase):
             self._refresher.stop()
         if self._joiner is not None:
             self._joiner.stop()
+        if self._pager is not None:
+            self._pager.stop()
         budget = max(self.config.drain_timeout_ms / 1000.0, 0.1)
         t0 = time.perf_counter()
         if self._batcher is not None:
@@ -1747,6 +1802,52 @@ class PredictionServer(HTTPServerBase):
                 raise
             self._slo.record(app, time.perf_counter() - t0, ok=True)
             return resp
+
+        @r.post("/shard/queries.json")
+        def shard_queries(req: Request) -> Response:
+            """Cross-host mesh member surface: serve this member's
+            catalog slice and return candidates WITH GLOBAL ITEM IDS,
+            so the router's merge re-top-k is exact (stable
+            (-score, gid) order + gid dedupe). Answers on non-mesh
+            members too (shard "", full catalog) — a mixed fleet
+            degrades to plain routing instead of 404ing."""
+            tenant = self.admission.resolve(req)
+            if self._pressure.shedding():
+                self._shed_counter.labels(
+                    surface="memory",
+                    app=tenant.label if tenant is not None else "").inc()
+                raise OverloadedError(
+                    "memory pressure: shedding new work", retry_after=1.0)
+            with self.admission.admit(tenant):
+                try:
+                    payload = req.json()
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                dep = self._dep
+                if dep.query_class is not None:
+                    query = extract_params(dep.query_class, payload)
+                else:
+                    query = payload
+                prediction = dep.predict_batch([query])[0]
+            out = to_jsonable(prediction)
+            scores = (out.get("itemScores") or ()) \
+                if isinstance(out, dict) else ()
+            cands = []
+            for s in scores:
+                name = s.get("item")
+                gid = None
+                for im in dep.item_maps:
+                    gid = im.get(name)
+                    if gid is not None:
+                        break
+                cands.append([-1 if gid is None else int(gid),  # lint: ok — host json
+                              s.get("score", 0.0), name])
+            num = getattr(query, "num", None) if not isinstance(
+                query, dict) else query.get("num")
+            return Response.json({
+                "shard": self.shard_spec(),
+                "num": int(num) if num else len(cands),  # lint: ok — host json
+                "cands": cands})
 
         @r.get("/")
         def index(req: Request) -> Response:

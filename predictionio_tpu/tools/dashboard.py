@@ -332,6 +332,30 @@ def _serving_panel(snapshot: dict) -> str:
             + "</table>")
 
 
+_TIER_PREFIXES = ("pio_tier_", "pio_plan_resident_bytes",
+                  "pio_fleet_shard_owner", "pio_fleet_mesh_merged")
+
+
+def _tier_panel(snapshot: dict) -> str:
+    """Summary table of the giant-catalog families: hot-slab size and
+    hit ratio, batched promotion counts and page pass latency, device
+    residency of the live plans, and cross-host shard ownership — the
+    operator's view of whether the demand-paged hot set has converged
+    (hit ratio high, promotions quiescent) and every mesh shard has an
+    admitted owner."""
+    rows = []
+    for name, fam in sorted(snapshot.items()):
+        if name.startswith(_TIER_PREFIXES):
+            rows.extend(_series_rows(name, fam))
+    if not rows:
+        return ("<h2>Tiered / mesh catalog</h2>"
+                "<p>No tiered plans or mesh shards active.</p>")
+    return ("<h2>Tiered / mesh catalog</h2>"
+            "<table border=1><tr><th>Family</th><th>Labels</th>"
+            "<th>Type</th><th>Value</th></tr>" + "".join(rows)
+            + "</table>")
+
+
 def _selfheal_panel(snapshot: dict) -> str:
     """Summary table of the self-healing families: loop beat ages and
     degraded roles (watchdog), stall/restart/death counts, the
@@ -531,6 +555,7 @@ def _metrics_page(metrics: MetricsRegistry, tsdb=None) -> str:
         + _serving_panel(snapshot) + _slo_panel(snapshot)
         + _quality_panel(snapshot)
         + _wire_panel(snapshot) + _tenancy_panel(snapshot)
+        + _tier_panel(snapshot)
         + _selfheal_panel(snapshot) + _durability_panel(snapshot) +
         "<h2>All families</h2>"
         "<table border=1><tr><th>Family</th><th>Labels</th><th>Type</th>"

@@ -99,7 +99,15 @@ _RESILIENT_DIRS = ("predictionio_tpu/serving/", "predictionio_tpu/data/",
 # np.array / float() on a jax array) force a blocking sync per call
 _DEVICE_HOT_PATHS = ("predictionio_tpu/ops/topk.py",
                      "predictionio_tpu/ops/topk_sharded.py",
+                     "predictionio_tpu/ops/topk_tiered.py",
                      "predictionio_tpu/serving/")
+
+# demand-paged tier: slab promotion (`.rebalance()`) and access folding
+# (`.fold_accesses()`) gather + re-upload the hot slab — strictly the
+# async page thread's job (serving/paging.PageManager). Called from a
+# serve or request path they re-serialize every query behind a device
+# upload.
+_PAGER_FILES = ("predictionio_tpu/serving/paging.py",)
 
 # template data sources: training reads must use the columnar scan
 _MODELS_DIRS = ("predictionio_tpu/models/",)
@@ -467,6 +475,35 @@ def _check_device_transfers(tree: ast.AST, text: str,
                    "host values")
 
 
+def _check_pager_thread(tree: ast.AST, text: str,
+                        rel: str) -> Iterator[str]:
+    """Slab paging runs ONLY on the async page thread: calls to
+    ``.rebalance(`` / ``.fold_accesses(`` outside serving/paging.py are
+    flagged — each is a batched slab gather + device upload that would
+    stall every in-flight query if it ran on a serve path. Tests and
+    benches (outside the package) drive paging deterministically and
+    are exempt; a deliberate in-package call site can carry
+    ``# lint: ok``."""
+    if not rel.startswith("predictionio_tpu/") or rel in _PAGER_FILES:
+        return
+    lines = text.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in ("rebalance", "fold_accesses")):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# lint: ok" in line:
+            continue
+        yield (f"{rel}:{node.lineno}: .{fn.attr}() belongs on the async "
+               "page thread (serving/paging.PageManager); a slab "
+               "promotion on a serve path stalls every query behind a "
+               "device upload — or mark '# lint: ok' for a "
+               "pager-driven context")
+
+
 def _check_training_reads(tree: ast.AST, text: str,
                           rel: str) -> Iterator[str]:
     """In models/: a ``read_training`` that iterates Events via
@@ -699,6 +736,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_urlopen_timeout(tree, text, rel))
     out.extend(_check_storage_writes(tree, text, rel))
     out.extend(_check_device_transfers(tree, text, rel))
+    out.extend(_check_pager_thread(tree, text, rel))
     out.extend(_check_training_reads(tree, text, rel))
     out.extend(_check_streaming_accumulation(tree, text, rel))
     out.extend(_check_hot_route(tree, text, rel))

@@ -561,3 +561,53 @@ def test_thread_name_gate_scoped_to_package(tmp_path):
         "    return threading.Thread(target=work)\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_pager_thread_gate_catches_serve_path_paging(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "serving" / "hotloop.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "def serve(plan, vecs, banned):\n"
+        "    plan.fold_accesses()\n"
+        "    plan.rebalance()\n"
+        "    return plan(vecs, banned)\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert ".fold_accesses() belongs on the async page thread" in kinds
+    assert ".rebalance() belongs on the async page thread" in kinds
+
+
+def test_pager_thread_gate_allows_pager_and_escape(tmp_path):
+    # serving/paging.py IS the page thread; elsewhere the line escape
+    # marks a deliberate pager-driven call site
+    pager = tmp_path / "predictionio_tpu" / "serving" / "paging.py"
+    pager.parent.mkdir(parents=True)
+    pager.write_text(
+        '"""doc"""\n'
+        "def tick(plans):\n"
+        "    for plan in plans:\n"
+        "        plan.fold_accesses()\n"
+        "        plan.rebalance()\n"
+    )
+    ok = tmp_path / "predictionio_tpu" / "serving" / "admin.py"
+    ok.write_text(
+        '"""doc"""\n'
+        "def force_page(plan):\n"
+        "    plan.fold_accesses()  # lint: ok — operator-forced page\n"
+        "    return plan.rebalance()  # lint: ok — operator-forced page\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_pager_thread_gate_scoped_to_package(tmp_path):
+    # tests and benches drive paging deterministically by design
+    ok = tmp_path / "tests" / "test_x.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def drive(plan):\n"
+        "    plan.fold_accesses()\n"
+        "    plan.rebalance()\n"
+    )
+    assert not lint.run(tmp_path)

@@ -1,0 +1,717 @@
+"""Giant-catalog suite: tiered factor storage + the cross-host serve
+mesh must be BIT-IDENTICAL to the single-device oracle.
+
+Covers the giant-catalog acceptance checklist:
+
+  - `TieredTopK` hot/cold merge exactness across bucket sizes, banned
+    lists hitting BOTH tiers (a banned hot item must not resurface
+    through the cold pass), k above the hot-slab size, and whole-model
+    hot swaps — vs the `BucketedTopK` oracle on integer-valued factors
+    (host f32 BLAS and device HIGHEST matmul agree bitwise)
+  - demand paging: skewed traffic converges the EWMA'd hot set to
+    >= 0.9 hit ratio with ZERO steady-state recompiles (the slab swaps
+    through the positional-operand bucket executables), and hysteresis
+    keeps a stationary distribution from thrashing the slab
+  - `ShardSliceTopK` member slices: disjoint coverage, global ids,
+    boundary-straddling bans, merged-union parity
+  - the cross-host mesh end to end: fleet router fan-out/merge
+    bit-equal to a single server, member kill -> HTTP 200 `partial:
+    true` (never a 5xx), remote members declaring shards via
+    heartbeats, shard ownership surviving a router restart through the
+    membership snapshot
+  - the device-capacity overcommit fix: `effective_device_capacity`
+    subtracts already-resident plan bytes (the back-to-back /reload
+    OOM) before fits-one-device decisions
+  - the lease-RTT floor: a TTL under 10x the store's measured CAS RTT
+    is clamped loudly at fleet start
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import compile_watch, get_registry
+from predictionio_tpu.ops import topk
+from predictionio_tpu.ops.topk_sharded import (
+    ShardSlice, ShardSliceTopK, effective_device_capacity,
+    parse_fleet_mesh, serve_mesh_from_conf, serve_plan,
+)
+from predictionio_tpu.ops.topk_tiered import TieredTopK
+from predictionio_tpu.serving.paging import PageManager
+
+pytestmark = pytest.mark.tiered
+
+
+def _host_reference(vecs, factors, banned_lists, k):
+    out_s, out_ix = [], []
+    for row in range(vecs.shape[0]):
+        sc = vecs[row] @ factors.T
+        if banned_lists[row]:
+            sc[np.asarray(banned_lists[row], int)] = topk.NEG_INF
+        order = np.argsort(-sc, kind="stable")[:k]
+        out_ix.append(order)
+        out_s.append(sc[order])
+    return np.array(out_s), np.array(out_ix)
+
+
+@pytest.fixture()
+def factors_407():
+    """407 integer-valued items: not divisible by the hot-slab sizes or
+    shard counts below, so every boundary case is exercised."""
+    rng = np.random.default_rng(7)
+    return rng.integers(-4, 5, size=(407, 8)).astype(np.float32)
+
+
+@pytest.fixture()
+def oracle_407(factors_407):
+    plan = topk.BucketedTopK(factors_407, k=6, buckets=(1, 2, 4, 8),
+                             banned_width=128)
+    plan.warm()
+    return plan
+
+
+@pytest.fixture()
+def tiered_407(factors_407):
+    plan = TieredTopK(factors_407, k=6, buckets=(1, 2, 4, 8),
+                      banned_width=128, hot_items=100)
+    assert plan.warm() == 4
+    return plan
+
+
+class TestTieredExactness:
+    def test_bit_identical_across_bucket_sizes(self, factors_407,
+                                               tiered_407, oracle_407):
+        rng = np.random.default_rng(3)
+        for b in (1, 2, 3, 5, 8):
+            vecs = rng.integers(-4, 5, size=(b, 8)).astype(np.float32)
+            banned = [sorted(rng.choice(
+                407, size=int(rng.integers(0, 20)),
+                replace=False).tolist()) for _ in range(b)]
+            s, ix = tiered_407(vecs, banned)
+            os_, oix = oracle_407(vecs, banned)
+            assert np.array_equal(ix, oix), f"id mismatch at batch {b}"
+            assert np.array_equal(s, os_), f"score mismatch at batch {b}"
+            ref_s, ref_ix = _host_reference(vecs, factors_407, banned, 6)
+            assert np.array_equal(ix, ref_ix)
+            assert np.array_equal(s, ref_s)
+
+    def test_bans_in_both_tiers_no_duplicates(self, tiered_407,
+                                              oracle_407):
+        """Ban lists straddling the hot/cold boundary (slab holds items
+        0..99 at start): a banned hot item must not resurface through
+        the cold pass — the hot-column mask sits strictly BELOW NEG_INF
+        — and no global id may appear twice in a merged row."""
+        vecs = np.ones((2, 8), np.float32)
+        banned = [list(range(90, 110)),     # straddles the boundary
+                  list(range(0, 100))]      # the ENTIRE hot slab
+        s, ix = tiered_407(vecs, banned)
+        os_, oix = oracle_407(vecs, banned)
+        assert np.array_equal(ix, oix)
+        assert np.array_equal(s, os_)
+        for row in range(2):
+            assert len(set(ix[row].tolist())) == 6, "duplicate gid"
+        assert not set(ix[1].tolist()) & set(range(100))
+
+    def test_k_above_hot_items(self, factors_407):
+        """k greater than the hot slab: the cold tier must supply the
+        remainder and the merge must stay exact."""
+        plan = TieredTopK(factors_407, k=24, buckets=(1, 2),
+                          banned_width=16, hot_items=10)
+        plan.warm()
+        oracle = topk.BucketedTopK(factors_407, k=24, buckets=(1, 2),
+                                   banned_width=16)
+        oracle.warm()
+        rng = np.random.default_rng(5)
+        vecs = rng.integers(-3, 4, size=(2, 8)).astype(np.float32)
+        s, ix = plan(vecs, [[], [3, 4, 5]])
+        os_, oix = oracle(vecs, [[], [3, 4, 5]])
+        assert np.array_equal(ix, oix)
+        assert np.array_equal(s, os_)
+
+    def test_all_banned_matches_oracle(self, factors_407):
+        plan = TieredTopK(factors_407, k=6, buckets=(1,),
+                          banned_width=512, hot_items=100)
+        plan.warm()
+        oracle = topk.BucketedTopK(factors_407, k=6, buckets=(1,),
+                                   banned_width=512)
+        oracle.warm()
+        vecs = np.ones((1, 8), np.float32)
+        banned = [list(range(407))]
+        s, ix = plan(vecs, banned)
+        os_, oix = oracle(vecs, banned)
+        assert np.array_equal(ix, oix)
+        assert np.array_equal(s, os_)
+
+    def test_swap_factors_roundtrip(self, factors_407, tiered_407,
+                                    oracle_407):
+        vecs = np.ones((1, 8), np.float32)
+        prev = tiered_407.swap_factors(factors_407 * 2.0)
+        assert prev is not None
+        s2, _ = tiered_407(vecs, [()])
+        tiered_407.swap_factors(factors_407)
+        s, ix = tiered_407(vecs, [()])
+        os_, oix = oracle_407(vecs, [()])
+        assert np.array_equal(ix, oix)
+        assert np.array_equal(s, os_)
+        assert s2[0, 0] == 2.0 * s[0, 0]
+        with pytest.raises(ValueError, match="catalog changed"):
+            tiered_407.swap_factors(np.ones((3, 8), np.float32))
+
+    def test_fits_contract(self, tiered_407):
+        assert tiered_407.fits(max_banned=128, k=6)
+        assert not tiered_407.fits(max_banned=129, k=6)
+        assert not tiered_407.fits(max_banned=4, k=7)
+
+
+def _popular_factors(n=400, rank=8, lo=200, hi=280, boost=20.0):
+    """Items [lo, hi) dominate dim 0 — OUTSIDE the initial hot slab
+    (which starts at items 0..hot-1), so a pager that does not adapt
+    never reaches a high hit ratio. Traffic vectors pin dim 0 positive,
+    so nearly every top-k answer comes from the popular block."""
+    rng = np.random.default_rng(11)
+    f = rng.integers(-2, 3, size=(n, rank)).astype(np.float32)
+    f[lo:hi, 0] += np.float32(boost)
+    return f
+
+
+def _popular_traffic(rng, batch=4, rank=8):
+    vecs = rng.integers(0, 4, size=(batch, rank)).astype(np.float32)
+    vecs[:, 0] = 3.0
+    return vecs
+
+
+class TestTieredPaging:
+    def test_skewed_traffic_converges_hot_and_stays_exact(self):
+        f = _popular_factors()
+        plan = TieredTopK(f, k=10, buckets=(1, 2, 4), banned_width=16,
+                          hot_items=100)
+        plan.warm()
+        oracle = topk.BucketedTopK(f, k=10, buckets=(1, 2, 4),
+                                   banned_width=16)
+        oracle.warm()
+        rng = np.random.default_rng(2)
+
+        def traffic(batches):
+            for _ in range(batches):
+                vecs = _popular_traffic(rng)
+                s, ix = plan(vecs, [()] * 4)
+                os_, oix = oracle(vecs, [()] * 4)
+                assert np.array_equal(ix, oix)
+                assert np.array_equal(s, os_)
+
+        traffic(15)                       # cold start: misses expected
+        assert plan.hit_ratio() < 0.5, "popular block started cold"
+        plan.fold_accesses()
+        assert plan.rebalance() > 0       # popular block pages in
+        plan.hits = plan.served = 0       # measure steady state only
+        with compile_watch() as w:
+            traffic(25)
+        assert w.count == 0, (
+            f"{w.count} steady-state recompiles — the slab swap must "
+            "reuse the AOT bucket executables")
+        assert plan.hit_ratio() >= 0.9, plan.stats()
+        assert plan.promotions_total > 0
+        assert plan.stats()["hot_items"] == 100
+
+    def test_stationary_traffic_never_thrashes(self):
+        """A STABLE served set must stop paging after it converges: the
+        incumbent retention bonus plus the deterministic id tie-break
+        (equal-EWMA filler slots) keep the desired set fixed, so a
+        second rebalance under the same traffic promotes nothing."""
+        f = _popular_factors()
+        plan = TieredTopK(f, k=10, buckets=(4,), banned_width=8,
+                          hot_items=100)
+        plan.warm()
+        vecs = np.ones((4, 8), np.float32)
+        vecs[:, 0] = 3.0
+        for _ in range(10):
+            plan(vecs, [()] * 4)
+        plan.fold_accesses()
+        assert plan.rebalance() > 0       # popular block pages in once
+        pages_after_converge = plan.page_count
+        for _ in range(6):
+            plan(vecs, [()] * 4)
+        plan.fold_accesses()
+        assert plan.rebalance() == 0
+        assert plan.page_count == pages_after_converge
+
+    def test_fold_accounts_and_decays(self, tiered_407):
+        vecs = np.ones((1, 8), np.float32)
+        tiered_407(vecs, [()])
+        assert tiered_407.fold_accesses() == 6       # one batch, k=6
+        peak = tiered_407._ewma.max()
+        assert tiered_407.fold_accesses() == 0       # buffer drained
+        assert tiered_407._ewma.max() < peak         # decay continues
+
+
+class TestPageManager:
+    def test_tick_promotes_and_publishes_metrics(self):
+        rng = np.random.default_rng(9)
+        f = rng.integers(-2, 3, size=(120, 8)).astype(np.float32)
+        f[60:90, 0] += np.float32(9.0)
+        plan = TieredTopK(f, k=5, buckets=(1,), banned_width=8,
+                          hot_items=20)
+        plan.warm()
+        mgr = PageManager(interval_s=60.0)   # ticked by hand
+        mgr.bind([plan])
+        vecs = np.zeros((3, 8), np.float32)
+        vecs[:, 0] = 2.0
+        plan(vecs, [()] * 3)
+        assert mgr.tick() > 0
+        reg = get_registry()
+        assert reg.value("pio_tier_hot_items", plan="0") == 20.0
+        assert reg.value("pio_tier_promotions_total", plan="0") > 0
+        assert reg.value("pio_tier_hit_ratio", plan="0") is not None
+
+    def test_thread_lifecycle_and_watchdog_beat(self):
+        rng = np.random.default_rng(10)
+        f = rng.integers(-2, 3, size=(40, 4)).astype(np.float32)
+        f[20:30, 0] += np.float32(9.0)
+        plan = TieredTopK(f, k=3, buckets=(1,), banned_width=4,
+                          hot_items=10)
+        plan.warm()
+        mgr = PageManager(interval_s=0.02)
+        mgr.bind([plan])
+        mgr.start()
+        try:
+            assert mgr.beat is not None
+            assert mgr.beat.role == "tier-pager"
+            assert not mgr.beat.degraded
+            vecs = np.zeros((2, 4), np.float32)
+            vecs[:, 0] = 2.0
+            plan(vecs, [()] * 2)
+            deadline = time.perf_counter() + 5.0
+            while plan.page_count == 0 and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert plan.page_count > 0, "pager thread never rebalanced"
+        finally:
+            mgr.stop()
+        assert mgr.beat is None
+        assert mgr._thread is None
+
+    def test_tick_survives_poison_plan(self):
+        class _Poison:
+            hot_items = 1
+
+            def fold_accesses(self):
+                raise RuntimeError("boom")
+
+            def rebalance(self, **kw):
+                raise RuntimeError("boom")
+
+            def hit_ratio(self):
+                return 0.0
+
+        mgr = PageManager(interval_s=60.0)
+        mgr.bind([_Poison()])
+        assert mgr.tick() == 0            # logged, never raised
+
+
+class TestShardSlice:
+    def test_parse_fleet_mesh(self):
+        assert parse_fleet_mesh("items=4@fleet") == (4, None)
+        assert parse_fleet_mesh("items=4@fleet:2") == (4, 2)
+        assert parse_fleet_mesh("items=8") is None
+        assert parse_fleet_mesh("") is None
+        with pytest.raises(ValueError, match="bad fleet mesh"):
+            parse_fleet_mesh("items=4@fleet:4")
+        with pytest.raises(ValueError, match="bad fleet mesh"):
+            parse_fleet_mesh("items=0@fleet")
+
+    def test_serve_mesh_from_conf_fleet_specs(self, monkeypatch):
+        monkeypatch.delenv("PIO_SERVE_SHARD", raising=False)
+        monkeypatch.delenv("PIO_SERVE_SHARDS", raising=False)
+        member = serve_mesh_from_conf({"mesh": "items=3@fleet:1"})
+        assert isinstance(member, ShardSlice)
+        assert member.n_shards == 3 and member.index == 1
+        # the ROUTER spec must not force local sharding on the process
+        # that merges
+        router = serve_mesh_from_conf({"mesh": "items=3@fleet"})
+        assert not isinstance(router, ShardSlice)
+        assert router is None or not router.forced
+
+    def _slices(self, factors, n=3, k=6, banned_width=64):
+        out = [ShardSliceTopK(factors, k=k, buckets=(1, 2),
+                              banned_width=banned_width,
+                              slice_spec=ShardSlice(n_shards=n, index=i))
+               for i in range(n)]
+        for p in out:
+            p.warm()
+        return out
+
+    def test_slices_cover_catalog_disjointly(self, factors_407):
+        slices = self._slices(factors_407)
+        spans = [(p.base, p._hi) for p in slices]
+        assert spans[0][0] == 0 and spans[-1][1] == 407
+        for (a, b), (c, d) in zip(spans, spans[1:]):
+            assert b == c and a < b
+
+    def test_union_merge_bit_identical_to_oracle(self, factors_407,
+                                                 oracle_407):
+        """Merging every member's global-id candidates by (-score, gid)
+        — exactly what the fleet router does — equals the oracle, with
+        bans straddling a slice boundary."""
+        slices = self._slices(factors_407)
+        rng = np.random.default_rng(6)
+        boundary = slices[0]._hi
+        for b in (1, 2):
+            vecs = rng.integers(-4, 5, size=(b, 8)).astype(np.float32)
+            banned = [list(range(boundary - 4, boundary + 4))
+                      for _ in range(b)]
+            cands = [p(vecs, banned) for p in slices]
+            os_, oix = oracle_407(vecs, banned)
+            for row in range(b):
+                pool = sorted(
+                    [(float(s[row, j]), int(ix[row, j]))
+                     for s, ix in cands for j in range(s.shape[1])],
+                    key=lambda t: (-t[0], t[1]))[:6]
+                assert [g for _, g in pool] == oix[row].tolist()
+                assert np.array_equal(
+                    np.array([sc for sc, _ in pool], np.float32),
+                    os_[row])
+
+    def test_bans_outside_slice_ignored(self, factors_407):
+        p = ShardSliceTopK(factors_407, k=4, buckets=(1,),
+                           banned_width=8,
+                           slice_spec=ShardSlice(n_shards=3, index=1))
+        p.warm()
+        vecs = np.ones((1, 8), np.float32)
+        # bans entirely in other slices: no effect, and no aliasing
+        # from an off-by-base translation
+        s1, ix1 = p(vecs, [[0, 1, 406]])
+        s2, ix2 = p(vecs, [()])
+        assert np.array_equal(ix1, ix2)
+        assert np.array_equal(s1, s2)
+        assert (ix1 >= p.base).all() and (ix1 < p._hi).all()
+
+    def test_empty_slice_raises(self):
+        tiny = np.ones((2, 4), np.float32)
+        with pytest.raises(ValueError, match="is empty"):
+            ShardSliceTopK(tiny, k=1, buckets=(1,), banned_width=4,
+                           slice_spec=ShardSlice(n_shards=3, index=2))
+
+
+class TestEffectiveCapacity:
+    def test_resident_plans_shrink_effective_capacity(self, monkeypatch):
+        """The overcommit fix: a live plan's factor bytes must come out
+        of the budget BEFORE fits-one-device decisions — back-to-back
+        /reloads (old plan still resident while the new one warms) used
+        to double-book the device."""
+        monkeypatch.setenv("PIO_DEVICE_HBM_BYTES", "10000000")
+        before = effective_device_capacity()
+        f = np.ones((1000, 8), np.float32)        # 32 KB resident
+        plan = topk.BucketedTopK(f, k=4, buckets=(1,), banned_width=4)
+        after = effective_device_capacity()
+        assert after == pytest.approx(before - f.nbytes)
+        del plan
+
+    def test_no_capacity_env_means_unbounded(self, monkeypatch):
+        monkeypatch.delenv("PIO_DEVICE_HBM_BYTES", raising=False)
+        assert effective_device_capacity() is None
+
+    def test_reload_overcommit_flips_to_tiered(self, monkeypatch):
+        """With the catalog at 80% of the remaining budget: the FIRST
+        deploy fits single-device; a second deploy while the first is
+        still resident must NOT — auto tiering takes over instead of
+        overcommitting the device."""
+        monkeypatch.setenv("PIO_SERVE_TIER", "auto")
+        monkeypatch.delenv("PIO_TIER_HOT_FRAC", raising=False)
+        rng = np.random.default_rng(8)
+        f = rng.integers(-3, 4, size=(500, 8)).astype(np.float32)
+        resident0 = topk.plan_resident_bytes()
+        budget = (resident0 + f.nbytes * 1.25) / 0.8
+        monkeypatch.setenv("PIO_DEVICE_HBM_BYTES", str(budget))
+        first = serve_plan(f, k=4, buckets=(1,), banned_width=4)
+        assert isinstance(first, topk.BucketedTopK)
+        second = serve_plan(f, k=4, buckets=(1,), banned_width=4)
+        assert isinstance(second, TieredTopK)
+        assert second.hot_items < 500
+        del first, second
+
+    def test_tier_mode_off_keeps_single_device(self, monkeypatch):
+        monkeypatch.setenv("PIO_DEVICE_HBM_BYTES", "4096")
+        monkeypatch.setenv("PIO_SERVE_TIER", "off")
+        f = np.ones((500, 8), np.float32)
+        plan = serve_plan(f, k=4, buckets=(1,), banned_width=4)
+        assert isinstance(plan, topk.BucketedTopK)
+
+    def test_tier_on_forces_and_hot_frac_sizes(self, monkeypatch):
+        monkeypatch.delenv("PIO_DEVICE_HBM_BYTES", raising=False)
+        monkeypatch.setenv("PIO_SERVE_TIER", "on")
+        monkeypatch.setenv("PIO_TIER_HOT_FRAC", "0.25")
+        f = np.ones((400, 8), np.float32)
+        plan = serve_plan(f, k=4, buckets=(1,), banned_width=4)
+        assert isinstance(plan, TieredTopK)
+        assert plan.hot_items == 100
+
+
+class _SlowLeases:
+    """Lease DAO stand-in with an injected CAS latency."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def acquire(self, name, holder, ttl_s, journal=None):
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return None
+
+    def release(self, name, holder):
+        time.sleep(self.delay_s)
+
+    def get(self, name):
+        return None
+
+
+class TestLeaseRTTFloor:
+    def test_measure_store_rtt_reflects_store_latency(self):
+        from predictionio_tpu.serving.fleet import measure_store_rtt
+        slow = _SlowLeases(0.02)
+        rtt = measure_store_rtt(slow, "h1", samples=3)
+        assert rtt >= 0.04          # acquire + release per sample
+        assert slow.calls == 3
+        fast = _SlowLeases(0.0)
+        assert measure_store_rtt(fast, "h1") < 0.04
+
+    def test_broken_store_measures_zero(self):
+        from predictionio_tpu.serving.fleet import measure_store_rtt
+
+        class _Broken:
+            def acquire(self, *a, **kw):
+                raise OSError("down")
+
+            def release(self, *a):
+                raise OSError("down")
+
+        assert measure_store_rtt(_Broken(), "h1") == 0.0
+
+    def _router(self, mem_registry, **fleet_kw):
+        from predictionio_tpu.serving.fleet import FleetConfig, FleetServer
+        from predictionio_tpu.serving.server import ServerConfig
+        return FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0),
+            fleet=FleetConfig(replicas=0, **fleet_kw),
+            registry=mem_registry)
+
+    def test_ttl_below_floor_is_clamped(self, mem_registry):
+        srv = self._router(mem_registry, lease_ttl_s=0.05,
+                           heartbeat_s=0.001)
+        srv._leases = _SlowLeases(0.02)
+        srv._apply_rtt_floor()
+        assert srv.store_rtt_s >= 0.04
+        assert srv.fleet.lease_ttl_s == pytest.approx(
+            10.0 * srv.store_rtt_s)
+        assert srv.fleet.heartbeat_s >= \
+            srv.fleet.lease_ttl_s / 3.0 - 1e-9
+        assert get_registry().value("pio_fleet_store_rtt_seconds") \
+            == pytest.approx(srv.store_rtt_s)
+
+    def test_generous_ttl_untouched(self, mem_registry):
+        srv = self._router(mem_registry, lease_ttl_s=30.0,
+                           heartbeat_s=5.0)
+        srv._leases = _SlowLeases(0.005)
+        srv._apply_rtt_floor()
+        assert srv.fleet.lease_ttl_s == 30.0
+        assert srv.fleet.heartbeat_s == 5.0
+
+
+@pytest.fixture()
+def trained_rec(mem_registry):
+    """Registry with a trained recommendation instance (mirrors
+    test_sharded_serve.trained_rec; separate copy so the modules stay
+    independently runnable)."""
+    from predictionio_tpu.core import (
+        CoreWorkflow, EngineParams, RuntimeContext,
+    )
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.models import recommendation as rec
+
+    apps = mem_registry.get_meta_data_apps()
+    app_id = apps.insert(App(0, "tierapp"))
+    events = mem_registry.get_events()
+    events.init(app_id)
+    rng = np.random.RandomState(0)
+    for u in range(12):
+        for i in range(15):
+            if rng.rand() > 0.6:
+                continue
+            events.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + i % 5)})), app_id)
+    ctx = RuntimeContext(registry=mem_registry)
+    engine = rec.engine()
+    params = EngineParams(
+        data_source_params=("", rec.DataSourceParams(app_name="tierapp")),
+        algorithm_params_list=(
+            ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=3,
+                                           seed=1)),))
+    CoreWorkflow.run_train(engine, params, ctx)
+    return mem_registry, engine
+
+
+def _query(port, user, num=5):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=json.dumps({"user": user, "num": num}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _wait(pred, timeout=8.0, interval=0.02, msg="condition"):
+    end = time.perf_counter() + timeout
+    while time.perf_counter() < end:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for: {msg}")
+
+
+class TestMeshCrossHost:
+    def _oracle_scores(self, trained_rec):
+        from predictionio_tpu.serving import PredictionServer, ServerConfig
+        registry, engine = trained_rec
+        srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                               registry=registry, engine=engine)
+        srv.start()
+        try:
+            return [_query(srv.port, f"u{q}")[1]["itemScores"]
+                    for q in range(12)]
+        finally:
+            srv.shutdown()
+
+    def test_mesh_fleet_bit_identical_and_degrades(self, trained_rec):
+        """The tentpole end to end: in-process replicas each own one
+        catalog shard (`ShardSliceTopK` over a slice), the router's
+        merge re-top-k equals the single-server answers bit for bit,
+        and killing a member degrades to `partial: true` — the client
+        NEVER sees a 5xx."""
+        from predictionio_tpu.serving import ServerConfig
+        from predictionio_tpu.serving.fleet import FleetConfig, FleetServer
+        registry, engine = trained_rec
+        oracle = self._oracle_scores(trained_rec)
+        fs = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0, mesh="items=2@fleet"),
+            fleet=FleetConfig(replicas=2, health_interval_s=0.1,
+                              eject_threshold=2),
+            registry=registry, engine=engine)
+        port = fs.start()
+        try:
+            assert fs._mesh_shards == 2
+            assert sorted(r.shard for r in fs._replicas) == ["0/2", "1/2"]
+            for rep in fs._replicas:
+                plan = rep.server._dep.algos[0]._serve_plan
+                assert isinstance(plan, ShardSliceTopK)
+            _query(port, "u0")          # settle non-topk lazies
+            with compile_watch() as w:
+                mesh = [_query(port, f"u{q}")[1]["itemScores"]
+                        for q in range(12)]
+            assert w.count == 0, (
+                f"{w.count} recompiles in mesh steady state")
+            assert mesh == oracle
+            _wait(lambda: get_registry().value(
+                "pio_fleet_shard_owner", shard="0/2",
+                member=fs._replicas[0].key) == 1.0,
+                msg="shard-owner gauge")
+            # member kill: the surviving shard serves, partial flagged
+            fs._replicas[1].server.shutdown()
+            status, out = _query(port, "u1")
+            assert status == 200
+            assert out["partial"] is True
+            assert out["degradedShards"] == ["1/2"]
+            assert out["itemScores"], "surviving shard must answer"
+            assert get_registry().value(
+                "pio_fleet_mesh_merged_total", outcome="partial") >= 1
+        finally:
+            fs.stop()
+
+    def test_remote_members_declare_shards_via_heartbeat(
+            self, trained_rec):
+        """`--join`-style members: a router-only mesh learns shard
+        ownership from heartbeats, merges across the registered members
+        bit-identically to the single-server oracle, and a fresh router
+        over the same store restores shard ownership from the
+        membership snapshot."""
+        from predictionio_tpu.serving import (
+            PredictionServer, ReplicaAgent, ServerConfig,
+        )
+        from predictionio_tpu.serving.fleet import FleetConfig, FleetServer
+        registry, engine = trained_rec
+        oracle = self._oracle_scores(trained_rec)
+        router = FleetServer(
+            ServerConfig(ip="127.0.0.1", port=0, mesh="items=2@fleet"),
+            fleet=FleetConfig(replicas=0, health_interval_s=0.1,
+                              heartbeat_s=0.1),
+            registry=registry, engine=engine)
+        rport = router.start()
+        members, agents = [], []
+        try:
+            for i in range(2):
+                srv = PredictionServer(
+                    ServerConfig(ip="127.0.0.1", port=0,
+                                 mesh=f"items=2@fleet:{i}"),
+                    registry=registry, engine=engine)
+                srv.start()
+                assert srv.shard_spec() == f"{i}/2"
+                agent = ReplicaAgent(
+                    srv, [f"http://127.0.0.1:{rport}"], heartbeat_s=0.1)
+                agent.start()
+                members.append(srv)
+                agents.append(agent)
+            _wait(lambda: sorted(
+                r.shard for r in router._replicas if r.admitted)
+                == ["0/2", "1/2"], msg="both shards admitted")
+            mesh = [_query(rport, f"u{q}")[1]["itemScores"]
+                    for q in range(12)]
+            assert mesh == oracle
+            # shard ownership survives a router restart: the membership
+            # snapshot carries it, so a fresh router re-admits owners
+            # without waiting for re-registration
+            router._persist_members()
+            router2 = FleetServer(
+                ServerConfig(ip="127.0.0.1", port=0,
+                             mesh="items=2@fleet"),
+                fleet=FleetConfig(replicas=0, health_interval_s=0.1),
+                registry=registry, engine=engine)
+            router2.start()
+            try:
+                _wait(lambda: sorted(
+                    r.shard for r in router2._replicas if r.admitted)
+                    == ["0/2", "1/2"], msg="snapshot-restored shards")
+            finally:
+                router2.stop()
+        finally:
+            for a in agents:
+                a.stop()
+            for m in members:
+                m.shutdown()
+            router.stop()
+
+    def test_server_pager_lifecycle_with_tiering(self, trained_rec,
+                                                 monkeypatch):
+        """A tier-forced deploy starts the pio-tier-pager thread, its
+        beat rides the server's own readiness beats, and shutdown stops
+        it."""
+        from predictionio_tpu.serving import PredictionServer, ServerConfig
+        monkeypatch.setenv("PIO_SERVE_TIER", "on")
+        monkeypatch.setenv("PIO_TIER_HOT_FRAC", "0.5")
+        registry, engine = trained_rec
+        srv = PredictionServer(ServerConfig(ip="127.0.0.1", port=0),
+                               registry=registry, engine=engine)
+        srv.start()
+        try:
+            plan = srv._dep.algos[0]._serve_plan
+            assert isinstance(plan, TieredTopK)
+            assert srv._pager is not None
+            assert any(b.role == "tier-pager" for b in srv._own_beats())
+            status, out = _query(srv.port, "u1")
+            assert status == 200 and out["itemScores"]
+        finally:
+            srv.stop()
+        assert srv._pager is None or srv._pager._thread is None
