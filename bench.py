@@ -2496,6 +2496,241 @@ def bench_pevlog(n_events: int = None):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _ingestd_service_worker():
+    """Child of bench_ingestd (argv: --only-ingestd-service
+    <pevlog_path> <block_rows>): serve the parent's pevlog store as an
+    ingest service, print `READY <port>` on stdout, run until SIGTERM.
+    A separate PROCESS, so the parent's RSS measurement sees only the
+    CONSUMER side of the disaggregated ingest path."""
+    from predictionio_tpu.data.storage import StorageRegistry
+    from predictionio_tpu.ingest.service import IngestConfig, IngestService
+
+    ix = sys.argv.index("--only-ingestd-service")
+    path, block_rows = sys.argv[ix + 1], int(sys.argv[ix + 2])
+    reg = StorageRegistry({
+        "PIO_STORAGE_SOURCES_PEVLOG_TYPE": "PEVLOG",
+        "PIO_STORAGE_SOURCES_PEVLOG_PATH": path,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PEVLOG",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PEVLOG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "PEVLOG",
+    })
+    svc = IngestService(
+        IngestConfig(ip="127.0.0.1", port=0, block_rows=block_rows), reg)
+    port = svc.start()
+    print(f"READY {port}", flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    while not done.is_set():
+        done.wait(1.0)
+    svc.shutdown()
+
+
+def bench_ingestd(n_events: int = None):
+    """Disaggregated ingest: a SUBPROCESS scan/prep service streams
+    CRC-framed column blocks to this process, whose transfer state is
+    capped by `PIO_INGEST_WINDOW_BYTES` — so a store >= 4x a
+    `PIO_MEM_LIMIT_BYTES`-style budget ingests with flat consumer RSS
+    above the preallocated output arrays, bit-identical to the local
+    scan, and two refreshers subscribing to the same delta coalesce
+    onto ONE underlying scan. Three hard gates (over-budget store,
+    bounded consumer overhead, shared-scan dedup) fail the section
+    loudly."""
+    import shutil
+    import subprocess
+    import tempfile
+    from datetime import datetime, timedelta, timezone
+
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.storage import StorageRegistry
+    from predictionio_tpu.ingest import blockproto as proto
+    from predictionio_tpu.ingest.client import _Endpoint, remote_scan_columns
+
+    budget = int(os.environ.get("PIO_MEM_LIMIT_BYTES", str(2 << 20)))
+    if n_events is None:
+        # 20 raw column bytes/row (2 i4 + f4 + i8): size the store to
+        # >= 4x the budget so "flat RSS" is a real claim, not slack
+        n_events = max(10_000, (4 * budget) // 20 + 10_000)
+    spec = {"rate": ("prop", "rating")}
+    window_mb = max(1, budget >> 20)
+
+    t_base = datetime(2023, 1, 1, tzinfo=timezone.utc)
+    tmp = tempfile.mkdtemp(prefix="ingestd-bench-")
+    saved_env = {k: os.environ.get(k) for k in (
+        "PIO_INGEST_SERVICE", "PIO_INGEST_WINDOW_BYTES", "PIO_WATCHDOG")}
+    child = None
+    try:
+        os.environ["PIO_WATCHDOG"] = "off"
+        reg = StorageRegistry({
+            "PIO_STORAGE_SOURCES_PEVLOG_TYPE": "PEVLOG",
+            "PIO_STORAGE_SOURCES_PEVLOG_PATH": tmp,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PEVLOG",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PEVLOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "PEVLOG",
+        })
+        ev = reg.get_events()
+        ev.init(1)
+        batch = [Event(event="rate", entity_type="user",
+                       entity_id=f"u{j % 997}", target_entity_type="item",
+                       target_entity_id=f"i{j % 4999}",
+                       properties=DataMap({"rating": float(j % 5) + 1.0}),
+                       event_time=t_base + timedelta(seconds=j))
+                 for j in range(100_000)]
+        done = 0
+        wm_mid = None
+        t0 = time.perf_counter()
+        while done < n_events:
+            n = min(len(batch), n_events - done)
+            # re-insertion is legal (ids are store-generated); the
+            # repeats land on identical timestamps, which the stable
+            # time-sort keeps in deterministic journal order
+            ev.insert_batch(batch[:n], 1)
+            done += n
+            if wm_mid is None and done >= n_events // 2:
+                wm_mid = ev.ingest_watermark(1)
+        t_ingest = time.perf_counter() - t0
+        wm_end = ev.ingest_watermark(1)
+
+        # -- local oracle (and the over-budget gate) --------------------
+        t0 = time.perf_counter()
+        local = ev.scan_columns(1, value_spec=spec)
+        t_local = time.perf_counter() - t0
+        col_bytes = (local.entity_ix.nbytes + local.target_ix.nbytes +
+                     local.value.nbytes + local.t_us.nbytes)
+        over_x = col_bytes / budget
+        if over_x < 4.0:
+            raise SystemExit(
+                f"ingestd: store columns {col_bytes}B only {over_x:.1f}x "
+                f"the {budget}B budget (need >= 4x)")
+
+        # -- remote ingest: flat-RSS + bit-exactness gates --------------
+        os.environ["PIO_INGEST_WINDOW_BYTES"] = str(budget)
+        block_rows = max(1024, budget // (8 * 20))   # ~1/8 window/block
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--only-ingestd-service", tmp, str(block_rows)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PIO_WATCHDOG="off"),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        ready = child.stdout.readline().strip()
+        if not ready.startswith("READY "):
+            raise SystemExit(f"ingestd: service child failed: {ready!r}")
+        port = int(ready.split()[1])
+        os.environ["PIO_INGEST_SERVICE"] = f"127.0.0.1:{port}"
+
+        peak = {"mb": 0.0}
+        stop = threading.Event()
+
+        def _sample():
+            while not stop.is_set():
+                peak["mb"] = max(peak["mb"], _rss_mb())
+                time.sleep(0.005)
+
+        rss0 = _rss_mb()
+        sampler = threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        t0 = time.perf_counter()
+        remote = remote_scan_columns(1, value_spec=spec)
+        t_remote = time.perf_counter() - t0
+        stop.set()
+        sampler.join(timeout=2.0)
+        for name in ("entity_ix", "target_ix", "value", "t_us"):
+            assert np.array_equal(getattr(remote, name),
+                                  getattr(local, name)), \
+                f"remote ingest diverged from local scan on {name}"
+        assert (remote.entities == local.entities and
+                remote.targets == local.targets), \
+            "remote ingest diverged on string tables"
+        assert remote.n == local.n and remote.n > 0, \
+            "remote path was not exercised (no rows streamed)"
+        cols_mb = col_bytes / (1 << 20)
+        # growth above baseline minus the (unavoidable) second copy of
+        # the output arrays = transfer-state overhead; gate it to one
+        # prefetch window plus allocator slack
+        overhead_mb = max(0.0, (peak["mb"] - rss0) - cols_mb)
+        if overhead_mb > window_mb + 16.0:
+            raise SystemExit(
+                f"ingestd: consumer overhead {overhead_mb:.1f}MB exceeds "
+                f"window {window_mb}MB + 16MB slack (RSS not flat)")
+
+        # -- shared-scan dedup: 2 refresher ticks, ONE scan -------------
+        # Both ticks POST the same (delta-spec, watermark) key at once;
+        # coalescing must hand them the SAME scan id, and the service
+        # must end up holding exactly 2 scans (full + delta) despite 4
+        # subscriptions total (2 POSTs here + 1 each inside the
+        # remote_scan_columns calls below).
+        delta_spec = proto.encode_spec(
+            1, None, value_spec=spec, since=wm_mid, upto=wm_end)
+        gate = threading.Barrier(2)
+        ids, results, errs = [], [], []
+
+        def _refresher_tick():
+            ep = _Endpoint("127.0.0.1", port)
+            try:
+                gate.wait(timeout=10.0)
+                ids.append(ep.start_scan(delta_spec)["scan"])
+                results.append(remote_scan_columns(
+                    1, value_spec=spec, since=wm_mid, upto=wm_end))
+            except Exception as e:   # noqa: BLE001 — re-raised below
+                errs.append(e)
+            finally:
+                ep.close()
+
+        threads = [threading.Thread(target=_refresher_tick)
+                   for _ in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60.0)
+        if errs:
+            raise errs[0]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/ingest/scans.json",
+                timeout=10) as resp:
+            n_scans = len(json.load(resp)["scans"])
+        n_unique = len(set(ids))
+        if n_unique != 1 or n_scans != 2:
+            raise SystemExit(
+                f"ingestd: 2 delta subscribers got {n_unique} scan ids "
+                f"and the service holds {n_scans} scans; expected one "
+                f"shared delta scan (2 total with the full scan)")
+        assert results[0].n == results[1].n and np.array_equal(
+            results[0].t_us, results[1].t_us), \
+            "coalesced subscribers got different deltas"
+        delta_oracle = ev.scan_columns(
+            1, value_spec=spec, since=wm_mid, upto=wm_end)
+        assert results[0].n == delta_oracle.n, \
+            "coalesced delta diverged from the local delta oracle"
+
+        emit("ingestd_store_over_budget_x", over_x, "x", over_x / 4.0)
+        # vs_baseline: remote throughput per local-scan throughput —
+        # the price of moving the scan off-host on loopback
+        emit("ingestd_remote_rows_per_s", local.n / t_remote,
+             "rows_per_s", t_local / t_remote)
+        emit("ingestd_consumer_rss_overhead_mb", overhead_mb, "mb",
+             overhead_mb / window_mb if window_mb else 0.0)
+        emit("ingestd_shared_scan_dedup_x", 2.0 / n_unique, "x", 1.0)
+        print(f"# ingestd: {done/1e3:.0f}k events, columns "
+              f"{cols_mb:.1f}MB vs {budget >> 20}MB budget "
+              f"({over_x:.1f}x); remote {t_remote*1e3:.0f}ms (window "
+              f"{window_mb}MB, peak overhead {overhead_mb:.1f}MB); "
+              f"local {t_local*1e3:.0f}ms; "
+              f"ingest {done/max(t_ingest, 1e-9)/1e3:.0f}k ev/s; "
+              f"2 delta subscribers -> 1 shared scan",
+              file=sys.stderr)
+    finally:
+        if child is not None:
+            child.terminate()
+            try:
+                child.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                child.kill()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_classification(n: int = 1_000_000, f: int = 100):
     """BASELINE config 2: NaiveBayes + RandomForest on user-attribute
     rows at 1M x 100 (the scale the r3 work advertised but never
@@ -3724,6 +3959,17 @@ def main():
         # 180 s on a dead tunnel for a device this path never touches)
         signal.signal(signal.SIGTERM, _on_sigterm)
         section(bench_pevlog)
+        return
+    if "--only-ingestd-service" in sys.argv:
+        # child of bench_ingestd: serve the shared store's column-block
+        # scans until the parent SIGTERMs us — no device probe, no
+        # metric emission of its own
+        _ingestd_service_worker()
+        return
+    if "--only-ingestd" in sys.argv:
+        # jax-free: the ingest tier is storage + HTTP, no device needed
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        section(bench_ingestd)
         return
     if "--only-fleet-replica-worker" in sys.argv:
         # child of bench_fleet_crosshost: serve the shared-store model

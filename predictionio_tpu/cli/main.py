@@ -254,6 +254,23 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="0.0.0.0")
     x.add_argument("--port", type=int, default=7070)
     x.add_argument("--stats", action="store_true")
+    x = sub.add_parser(
+        "ingestd", help="disaggregated scan/prep service: owns the "
+                        "columnar scan and streams CRC-framed column "
+                        "blocks to trainers/refreshers")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=7200)
+    x.add_argument("--block-rows", type=int, default=0,
+                   help="rows per streamed block "
+                        "(default PIO_INGEST_BLOCK_ROWS or 65536)")
+    x.add_argument("--workers", type=int, default=None,
+                   help="scan worker pool width "
+                        "(default PIO_INGEST_WORKERS)")
+    x.add_argument("--join", default="",
+                   help="comma-separated router URLs to register with "
+                        "as a role=ingest fleet member")
+    x.add_argument("--advertise", default="",
+                   help="host:port other hosts reach this service at")
     x = sub.add_parser("dashboard")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=9000)
@@ -591,6 +608,31 @@ def main(argv: Optional[list] = None) -> int:
             port = server.start()
             print(f"Event server started on {args.ip}:{port}", flush=True)
             _serve_forever(server)
+            return 0
+        if cmd == "ingestd":
+            from predictionio_tpu.ingest.service import (
+                IngestConfig, IngestService,
+            )
+            server = IngestService(
+                IngestConfig(ip=args.ip, port=args.port,
+                             block_rows=args.block_rows,
+                             workers=args.workers), _registry())
+            port = server.start()
+            print(f"Ingest service started on {args.ip}:{port}",
+                  flush=True)
+            agent = None
+            if args.join:
+                from predictionio_tpu.serving.fleet import ReplicaAgent
+                agent = ReplicaAgent(
+                    server, args.join.split(","),
+                    advertise=args.advertise or f"{args.ip}:{port}",
+                    role="ingest")
+                agent.start()
+            try:
+                _serve_forever(server)
+            finally:
+                if agent is not None:
+                    agent.stop()
             return 0
         if cmd == "dashboard":
             from predictionio_tpu.tools.dashboard import (

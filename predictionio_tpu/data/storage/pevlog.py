@@ -1506,10 +1506,25 @@ def _scan_pool(procs: int):
             if _SCAN_POOL is not None:
                 _SCAN_POOL.shutdown(wait=False)
             _SCAN_POOL, _SCAN_POOL_PROCS = pool, procs
+            _count_pool_spawn()
             return pool
         except Exception:
             _SCAN_POOL_PROCS = -1
             return None
+
+
+def _count_pool_spawn() -> None:
+    """`pio_ingest_pool_spawns_total` is the steady-state proof that the
+    spawn pool is REUSED across refresher ticks / cache invalidations:
+    flat after warmup, climbing = something is tearing the pool down."""
+    try:
+        from predictionio_tpu.obs import metrics as obs_metrics
+        obs_metrics.get_registry().counter(
+            "pio_ingest_pool_spawns_total",
+            "Spawn-start scan worker pools created (flat in steady "
+            "state: the pool is shared across scans)").inc()
+    except Exception:   # noqa: BLE001 — metrics must never break a scan
+        pass
 
 
 def _frame_chunks(path: Path, size: int, procs: int):

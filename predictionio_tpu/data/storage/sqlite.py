@@ -17,12 +17,12 @@ import threading
 import uuid
 from datetime import datetime
 from pathlib import Path
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.event import (
     DataMap, Event, from_millis, to_millis, utcnow,
 )
-from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage import base, columns
 from predictionio_tpu.data.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
     SLOObjective, TenantQuota, _UNSET,
@@ -74,6 +74,12 @@ META_DDL = (
         PRIMARY KEY (appid, channel))""",
     """CREATE TABLE IF NOT EXISTS slo_objectives (
         appid INTEGER PRIMARY KEY, latency_ms REAL, target REAL)""",
+    # ingest watermark: one generation counter per event table, bumped
+    # inside every write transaction — the monotone content fingerprint
+    # behind `ingest_watermark()` (prepared-data cache + refresher noop
+    # detection for SQL stores)
+    """CREATE TABLE IF NOT EXISTS events_ingest_gen (
+        tbl TEXT PRIMARY KEY, gen INTEGER NOT NULL)""",
 )
 
 # Additive schema migrations for stores created before a column existed;
@@ -697,6 +703,7 @@ class SQLiteEvents(base.EventStore):
         t = event_table_name(app_id, channel_id)
         with self.c.lock, self.c.conn:
             self.c.conn.execute(f"DROP TABLE IF EXISTS {t}")
+            self._bump_gen(t)
         self._known.discard((app_id, channel_id))
         return True
 
@@ -717,6 +724,7 @@ class SQLiteEvents(base.EventStore):
                      e.properties.to_json(), to_millis(e.event_time),
                      json.dumps(list(e.tags)), e.pr_id,
                      to_millis(e.creation_time)))
+                self._bump_gen(t)
         except sqlite3.IntegrityError as ex:
             raise base.StorageWriteError(str(ex)) from ex
         return e.event_id
@@ -738,6 +746,7 @@ class SQLiteEvents(base.EventStore):
             with self.c.lock, self.c.conn:
                 self.c.conn.executemany(
                     f"INSERT INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+                self._bump_gen(t)
         except sqlite3.IntegrityError as ex:
             raise base.StorageWriteError(str(ex)) from ex
         return out
@@ -768,6 +777,8 @@ class SQLiteEvents(base.EventStore):
         with self.c.lock, self.c.conn:
             cur = self.c.conn.execute(
                 f"DELETE FROM {t} WHERE id=?", (event_id,))
+            if cur.rowcount > 0:
+                self._bump_gen(t)
             return cur.rowcount > 0
 
     def find(self, app_id: int, channel_id: Optional[int] = None, *,
@@ -836,3 +847,128 @@ class SQLiteEvents(base.EventStore):
                         if limit is not None and 0 < limit <= len(events):
                             break
         return iter(events)
+
+    # -- columnar scan + ingest watermark ------------------------------------
+
+    def _bump_gen(self, table: str) -> None:
+        # caller holds the lock + transaction of the triggering write
+        self.c.conn.execute(
+            "INSERT INTO events_ingest_gen (tbl, gen) VALUES (?, 1) "
+            "ON CONFLICT(tbl) DO UPDATE SET gen = gen + 1", (table,))
+
+    def ingest_watermark(self, app_id: int,
+                         channel_id: Optional[int] = None
+                         ) -> Optional[Dict[str, int]]:
+        t = event_table_name(app_id, channel_id)
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT gen FROM events_ingest_gen WHERE tbl=?",
+                (t,)).fetchone()
+        return {"gen": int(row[0]) if row else 0}
+
+    def ingest_cache_dir(self, app_id: int,
+                         channel_id: Optional[int] = None):
+        # file-backed sqlite only: :memory: stores and the Postgres
+        # subclass (no local db file) have no natural on-disk home
+        path = getattr(self.c, "path", None)
+        if not path or path == ":memory:":
+            return None
+        d = Path(path).parent / "ingest_cache" / \
+            event_table_name(app_id, channel_id)
+        return str(d)
+
+    def scan_columns(self, app_id: int, channel_id: Optional[int] = None, *,
+                     start_time: Optional[datetime] = None,
+                     until_time: Optional[datetime] = None,
+                     entity_type: Optional[str] = None,
+                     entity_id: Optional[str] = None,
+                     event_names: Optional[Sequence[str]] = None,
+                     target_entity_type: object = _UNSET,
+                     target_entity_id: object = _UNSET,
+                     properties=None,
+                     value_spec=None, require_target: bool = True,
+                     workers: Optional[int] = None,
+                     since: Optional[Dict[str, int]] = None,
+                     upto: Optional[Dict[str, int]] = None):
+        """Native columnar scan: SQL projection of exactly the five
+        columns the row stream needs, with the same index pushdown as
+        `find()` — no Event objects, no full-row decode. Rows arrive in
+        find()'s exact order (eventtime ASC, id ASC), so the
+        BlockBuilder's first-seen interning reproduces the Event-oracle
+        tables bit-for-bit.
+
+        The gen-counter watermark carries no byte offsets: a `since`
+        delta cannot be sliced out of a mutable SQL table, so the
+        streaming path gets `DeltaInvalidated` and full-rebuilds."""
+        if since is not None:
+            raise base.DeltaInvalidated(
+                "sqlite watermark has no delta offsets")
+        del upto, workers   # no delta slicing; scan is single-cursor
+        t = event_table_name(app_id, channel_id)
+        self._ensure(app_id, channel_id)
+        clauses, params = [], []
+        if start_time is not None:
+            clauses.append("eventtime >= ?")
+            params.append(to_millis(start_time))
+        if until_time is not None:
+            clauses.append("eventtime < ?")
+            params.append(to_millis(until_time))
+        if entity_type is not None:
+            clauses.append("entitytype = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entityid = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            names = list(event_names)
+            clauses.append(
+                "event IN (" + ",".join("?" * len(names)) + ")")
+            params.extend(names)
+        if target_entity_type is not _UNSET:
+            if target_entity_type is None:
+                clauses.append("targetentitytype IS NULL")
+            else:
+                clauses.append("targetentitytype = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not _UNSET:
+            if target_entity_id is None:
+                clauses.append("targetentityid IS NULL")
+            else:
+                clauses.append("targetentityid = ?")
+                params.append(target_entity_id)
+        if require_target:
+            # pushdown of the require_target row drop: the builder
+            # would skip NULL-target rows anyway, the index shouldn't
+            # have to surface them first
+            clauses.append("targetentityid IS NOT NULL")
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        spec = columns.normalize_value_spec(value_spec)
+        # properties JSON only needs parsing when a value rule reads a
+        # prop or a property post-filter is present
+        need_props = bool(properties) or any(
+            ent[0] != "const" for ent in spec.values())
+        b = columns.BlockBuilder()
+        with self.c.lock:
+            cur = self.c.conn.execute(
+                f"SELECT event, entityid, targetentityid, properties, "
+                f"eventtime FROM {t}{where} ORDER BY eventtime ASC, id ASC",
+                params)
+            for name, eid, tei, props_json, ms in cur:
+                props = json.loads(props_json) if (
+                    need_props and props_json) else None
+                if properties:
+                    if props is None:
+                        break_row = True
+                    else:
+                        break_row = any(
+                            k not in props or props[k] != v
+                            for k, v in properties.items())
+                    if break_row:
+                        continue
+                v = columns.eval_value(spec, name, props)
+                if v is None:
+                    continue
+                if require_target and tei is None:
+                    continue
+                b.add(eid, tei, float(v), ms * 1000)
+        return columns.merge_blocks([b.block()])

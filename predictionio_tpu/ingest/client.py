@@ -1,0 +1,327 @@
+"""Consumer side of the disaggregated ingest tier.
+
+`PIO_INGEST_SERVICE=host:port[,host:port...]` flips any consumer of
+`scan_columns` — the pipeline builders, the streaming `Refresher`,
+`pio train` — into remote-ingest mode: the scan runs on the ingest
+service and the consumer assembles CRC-framed column blocks
+(`ingest.blockproto`) into the exact `EventColumns` a local scan would
+have produced, pulling blocks through a bounded prefetch window
+(`PIO_INGEST_WINDOW_BYTES`, default 32 MiB) so RSS stays flat no
+matter how large the store is.
+
+Failure ladder, cheapest first:
+  1. torn/corrupt block        -> re-fetch the same seq (up to 3x)
+  2. endpoint dead mid-stream  -> re-POST the spec on the next endpoint
+                                  (the assembler restarts; scans are
+                                  coalesced server-side so the retry is
+                                  cheap at an unchanged watermark)
+  3. every endpoint dead       -> `IngestUnavailable`; the
+                                  `RemoteIngestStore` wrapper falls back
+                                  to the wrapped store's local scan
+                                  unless `PIO_INGEST_FALLBACK=off`.
+
+`maybe_remote(store)` is the one integration point: pipeline and
+refresher call it on whatever `storage().get_events()` returned, and it
+is a no-op unless the env knob is set.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import queue
+import threading
+from typing import List, Optional, Tuple
+
+from predictionio_tpu.data import integrity
+from predictionio_tpu.data.storage import columns as C
+from predictionio_tpu.data.storage.base import DeltaInvalidated
+from predictionio_tpu.ingest import blockproto as proto
+from predictionio_tpu.obs import get_logger
+from predictionio_tpu.obs import metrics as obs_metrics
+
+_log = get_logger(__name__)
+
+ENV_SERVICE = "PIO_INGEST_SERVICE"
+ENV_WINDOW = "PIO_INGEST_WINDOW_BYTES"
+ENV_FALLBACK = "PIO_INGEST_FALLBACK"
+
+DEFAULT_WINDOW_BYTES = 32 << 20
+_BLOCK_RETRIES = 3          # per-seq CRC re-fetches before failover
+_CONNECT_TIMEOUT_S = 10.0
+_SCAN_TIMEOUT_S = 600.0     # POST may block while the service scans
+
+
+class IngestUnavailable(RuntimeError):
+    """Every configured ingest endpoint failed; the caller decides
+    whether to fall back to a local scan."""
+
+
+def endpoints(env: Optional[str] = None) -> List[Tuple[str, int]]:
+    """Parse `PIO_INGEST_SERVICE` into (host, port) pairs."""
+    raw = env if env is not None else os.environ.get(ENV_SERVICE, "")
+    out: List[Tuple[str, int]] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"{ENV_SERVICE} entry {part!r} is not host:port")
+        out.append((host, int(port)))
+    return out
+
+
+def window_bytes() -> int:
+    try:
+        return int(os.environ.get(ENV_WINDOW, "") or DEFAULT_WINDOW_BYTES)
+    except ValueError:
+        return DEFAULT_WINDOW_BYTES
+
+
+def fallback_enabled() -> bool:
+    return os.environ.get(ENV_FALLBACK, "").strip().lower() not in (
+        "off", "0", "false", "no")
+
+
+def _metrics():
+    reg = obs_metrics.get_registry()
+    return {
+        "scans": reg.counter(
+            "pio_ingest_remote_scans_total",
+            "Remote ingest scans by terminal outcome",
+            labels=("outcome",)),
+        "blocks": reg.counter(
+            "pio_ingest_remote_blocks_total",
+            "Column blocks fetched from the ingest service"),
+        "retries": reg.counter(
+            "pio_ingest_remote_retries_total",
+            "Block re-fetches after a torn/corrupt frame"),
+    }
+
+
+class _Endpoint:
+    """One persistent connection to one ingest service replica."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=_SCAN_TIMEOUT_S)
+        return self._conn
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                pass
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None) -> Tuple[int, dict, bytes]:
+        conn = self._connection()
+        headers = {"Content-Type": "application/json"} if body else {}
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        except Exception:
+            # a dead keep-alive poisons every later request on this
+            # conn; drop it so the next call redials
+            self.close()
+            raise
+
+    def start_scan(self, spec: dict) -> dict:
+        status, headers, data = self._request(
+            "POST", "/ingest/scan.json",
+            json.dumps(spec, separators=(",", ":")).encode())
+        if status == 409 or headers.get(
+                "X-Pio-Ingest-Error") == "delta_invalidated":
+            raise DeltaInvalidated("ingest service: delta invalidated")
+        if status != 200:
+            raise ConnectionError(
+                f"ingest scan failed: HTTP {status} {data[:200]!r}")
+        return json.loads(data.decode())
+
+    def fetch_block(self, scan_id: str, seq: int) -> bytes:
+        status, _headers, data = self._request(
+            "GET", f"/ingest/block/{scan_id}/{seq}")
+        if status != 200:
+            raise ConnectionError(
+                f"ingest block {seq} failed: HTTP {status}")
+        return data
+
+
+class _Prefetcher:
+    """Pulls blocks ahead of the assembler, bounded by window bytes —
+    the consumer never holds more than one window of undecoded frames
+    above the preallocated output arrays."""
+
+    def __init__(self, ep: _Endpoint, scan_id: str, n_blocks: int,
+                 budget_bytes: int, metrics: dict):
+        self._ep = ep
+        self._scan = scan_id
+        self._n = n_blocks
+        self._q: "queue.Queue" = queue.Queue()
+        self._budget = threading.BoundedSemaphore(
+            max(1, budget_bytes // (1 << 20)))
+        self._stop = threading.Event()
+        self._m = metrics
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pio-ingest-prefetch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        for seq in range(self._n):
+            if self._stop.is_set():
+                return
+            try:
+                blob = self._fetch_checked(seq)
+            except Exception as e:   # noqa: BLE001 — handed to consumer
+                self._q.put(("err", seq, e))
+                return
+            # charge ceil(MiB) against the window before handing over
+            for _ in range(max(1, len(blob) >> 20)):
+                while not self._budget.acquire(timeout=0.5):
+                    if self._stop.is_set():
+                        return
+            self._q.put(("ok", seq, blob))
+        self._q.put(("eof", self._n, None))
+
+    def _fetch_checked(self, seq: int) -> bytes:
+        """Fetch one seq, re-fetching on a torn/corrupt frame: the
+        resume-from-offset path — a CRC reject never restarts the
+        scan, only the one block."""
+        last: Exception = integrity.CorruptBlobError("unreached")
+        for attempt in range(_BLOCK_RETRIES):
+            blob = self._ep.fetch_block(self._scan, seq)
+            try:
+                integrity.unwrap(blob)
+                return blob
+            except integrity.CorruptBlobError as e:
+                last = e
+                self._m["retries"].inc()
+        raise last
+
+    def get(self, timeout: float = _SCAN_TIMEOUT_S):
+        kind, seq, payload = self._q.get(timeout=timeout)
+        if kind == "ok":
+            for _ in range(max(1, len(payload) >> 20)):
+                try:
+                    self._budget.release()
+                except ValueError:
+                    break
+        return kind, seq, payload
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def _remote_scan_once(ep: _Endpoint, spec: dict,
+                      metrics: dict) -> C.EventColumns:
+    info = ep.start_scan(spec)
+    scan_id, rows = info["scan"], int(info["rows"])
+    n_blocks = int(info["blocks"])
+    asm = proto.BlockAssembler(scan_id, rows)
+    if n_blocks == 0:
+        return asm.columns()
+    pre = _Prefetcher(ep, scan_id, n_blocks, window_bytes(), metrics)
+    try:
+        while not asm.complete:
+            kind, seq, payload = pre.get()
+            if kind == "err":
+                raise payload
+            if kind == "eof":
+                break
+            header, arrays = proto.decode_block(payload)
+            asm.add(header, arrays)
+            metrics["blocks"].inc()
+    finally:
+        pre.close()
+    return asm.columns()
+
+
+def remote_scan_columns(app_id: int, channel_id: Optional[int] = None,
+                        **kwargs) -> C.EventColumns:
+    """Run `scan_columns` on the ingest service tier. Tries each
+    configured endpoint in order; raises `IngestUnavailable` when all
+    fail, `DeltaInvalidated` verbatim when the service's store cannot
+    serve the requested delta."""
+    eps = endpoints()
+    if not eps:
+        raise IngestUnavailable(f"{ENV_SERVICE} not set")
+    m = _metrics()
+    spec = proto.encode_spec(app_id, channel_id, **kwargs)
+    errors: List[str] = []
+    for host, port in eps:
+        ep = _Endpoint(host, port)
+        try:
+            cols = _remote_scan_once(ep, spec, m)
+            m["scans"].labels(outcome="ok").inc()
+            return cols
+        except DeltaInvalidated:
+            m["scans"].labels(outcome="delta_invalidated").inc()
+            raise
+        except proto.BlockProtocolError:
+            # protocol bugs are not transport flakes: surface, don't
+            # silently grind through the endpoint list
+            m["scans"].labels(outcome="error").inc()
+            raise
+        except Exception as e:   # noqa: BLE001 — connection-level failover
+            errors.append(f"{host}:{port}: {type(e).__name__}: {e}")
+            _log.warning("ingest_endpoint_failed", endpoint=f"{host}:{port}",
+                         error=str(e))
+        finally:
+            ep.close()
+    m["scans"].labels(outcome="unavailable").inc()
+    raise IngestUnavailable("; ".join(errors))
+
+
+class RemoteIngestStore:
+    """An `EventStore` facade whose `scan_columns` runs on the ingest
+    tier and whose every other method hits the wrapped local store.
+    With `PIO_INGEST_FALLBACK` unset, a dead ingest tier degrades to
+    the wrapped store's own scan (and counts outcome=fallback)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    @property
+    def inner(self):
+        return self._inner
+
+    def scan_columns(self, app_id: int, channel_id: Optional[int] = None,
+                     *, workers: Optional[int] = None, **kwargs):
+        # `workers` sizes the SERVICE-side pool, not ours: drop it from
+        # the wire spec and let the service apply its own config
+        try:
+            return remote_scan_columns(app_id, channel_id, **kwargs)
+        except DeltaInvalidated:
+            raise
+        except (IngestUnavailable, proto.BlockProtocolError) as e:
+            if not fallback_enabled():
+                raise
+            _log.warning("ingest_fallback_local", error=str(e))
+            _metrics()["scans"].labels(outcome="fallback").inc()
+            return self._inner.scan_columns(
+                app_id, channel_id, workers=workers, **kwargs)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+def maybe_remote(store):
+    """Wrap `store` for remote ingest iff `PIO_INGEST_SERVICE` is set.
+    Idempotent, so pipeline and refresher can both call it safely."""
+    if isinstance(store, RemoteIngestStore):
+        return store
+    if not os.environ.get(ENV_SERVICE, "").strip():
+        return store
+    return RemoteIngestStore(store)

@@ -417,6 +417,8 @@ def _prepared(store, app_id, channel_id, sig, kind, filters, spec,
               workers, cache, finalize):
     """scan -> finalize -> cache plumbing shared by both builders.
     `finalize(EventColumns) -> (arrays dict, tables dict)`."""
+    from predictionio_tpu.ingest.client import maybe_remote
+    store = maybe_remote(store)   # PIO_INGEST_SERVICE routes the scan out
     cache_dir = _cache_dir(store, app_id, channel_id, cache)
     path = watermark = None
     if cache_dir is not None:

@@ -196,6 +196,7 @@ class _Replica:
         self.model_id = ""
         self.name = ""            # supervisor child name, from heartbeats
         self.shard = ""           # mesh shard owned ("i/n"), "" = whole
+        self.role = "serve"       # serve|ingest: only serve joins rotation
 
     @property
     def key(self) -> str:
@@ -229,7 +230,7 @@ class _Replica:
                     "state": self.state, "admitted": self.admitted,
                     "failures": self.failures, "inflight": self.inflight,
                     "model": self.model_id, "name": self.name,
-                    "shard": self.shard,
+                    "shard": self.shard, "role": self.role,
                     "beat_age_s": round(time.monotonic() - self.last_beat, 3)}
 
 
@@ -734,7 +735,7 @@ class FleetServer(HTTPServerBase):
         restarted router re-admits remote replicas immediately instead
         of waiting a full re-registration interval."""
         remote = [{"member": r.key, "model": r.model_id,
-                   "shard": r.shard}
+                   "shard": r.shard, "role": r.role}
                   for r in list(self._replicas) if r.remote]
         try:
             self.ctx.registry.get_model_data_models().insert(Model(
@@ -768,6 +769,7 @@ class FleetServer(HTTPServerBase):
             rep = self._add_member(host, int(port_s))  # lint: ok — host str
             rep.model_id = str(entry.get("model", ""))
             rep.shard = str(entry.get("shard", ""))
+            rep.role = str(entry.get("role", "")) or "serve"
             if self._probe(rep):
                 rep.beat()
                 self._admit(rep)
@@ -804,6 +806,9 @@ class FleetServer(HTTPServerBase):
             shard = str(body.get("shard", ""))
             if shard != rep.shard:
                 rep.shard = shard  # mesh shard this member declares
+            role = str(body.get("role", "")) or "serve"
+            if role != rep.role:
+                rep.role = role   # ingest members never enter rotation
             # retiring members stay out of rotation but keep beating:
             # a drain-in-progress must not re-admit (nor eject) itself
             busy = rep.state in ("reloading", "stopping", "retiring")
@@ -1075,7 +1080,8 @@ class FleetServer(HTTPServerBase):
     def _rotation(self) -> List[_Replica]:
         """Admitted members, round-robin rotated so consecutive
         requests spread; the non-admitted are excluded entirely."""
-        admitted = [r for r in self._replicas if r.admitted]
+        admitted = [r for r in self._replicas
+                    if r.admitted and r.role == "serve"]
         if not admitted:
             return []
         with self._rr_lock:
@@ -1655,7 +1661,8 @@ class ReplicaAgent:
 
     def __init__(self, server: PredictionServer, routers: Sequence[str],
                  advertise: str = "", server_key: str = "",
-                 heartbeat_s: float = 0.0, member_name: str = ""):
+                 heartbeat_s: float = 0.0, member_name: str = "",
+                 role: str = "serve"):
         self.server = server
         self.routers = [u.rstrip("/") for u in routers if u]
         self.advertise = advertise
@@ -1664,6 +1671,10 @@ class ReplicaAgent:
         # supervisor child name (--member-name): lets the router map a
         # member back to the child the autoscaler can retire
         self.member_name = member_name
+        # role="ingest" rides the same membership/heartbeat machinery
+        # (liveness, /fleet members, metrics federation) but is kept out
+        # of the query rotation by the router
+        self.role = role
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._router_down: Dict[str, bool] = {}
@@ -1710,7 +1721,7 @@ class ReplicaAgent:
         return json.dumps({"member": self.advertise,
                            "model": self.server.current_instance_id(),
                            "name": self.member_name,
-                           "shard": shard,
+                           "shard": shard, "role": self.role,
                            "ready": bool(ready)}).encode()
 
     def _post(self, url: str, data: bytes) -> dict:

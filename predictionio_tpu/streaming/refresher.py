@@ -178,6 +178,10 @@ class Refresher:
         if located is None:
             return "no_app"
         events, app_id, channel_id, ds_params = located
+        # PIO_INGEST_SERVICE reroutes the delta scans below through the
+        # shared ingest tier (watermark + find stay on the local store)
+        from predictionio_tpu.ingest.client import maybe_remote
+        events = maybe_remote(events)
         wm_now = events.ingest_watermark(app_id, channel_id)
         if wm_now is None:
             return "no_watermark"       # driver can't delta: stay passive

@@ -714,6 +714,47 @@ def _check_tenant_growth(tree: ast.AST, text: str,
                    "externally-bounded write '# lint: ok'")
 
 
+# the disaggregated ingest service: the whole point of the tier is
+# bounded streaming, so whole-store materialization is design-breaking
+_INGEST_SERVICE_FILES = ("predictionio_tpu/ingest/service.py",)
+
+
+def _check_ingest_materialization(tree: ast.AST, text: str,
+                                  rel: str) -> Iterator[str]:
+    """In ingest/service.py: forbid whole-store materialization on the
+    serving hot paths — ``.find(``/``find_events(`` (the Event-object
+    walk) anywhere, and ``.scan_columns(`` unless the call line carries
+    a ``# block-budget:`` marker naming the bound that slices the
+    result into blocks before it leaves the tier. The service exists to
+    stream bounded column blocks; an unmarked full materialization here
+    silently reintroduces the per-consumer RSS spike the tier removes.
+    ``# lint: ok`` also escapes, for non-hot admin paths."""
+    if rel not in _INGEST_SERVICE_FILES:
+        return
+    lines = text.splitlines()
+
+    def line(n: int) -> str:
+        return lines[n - 1] if n <= len(lines) else ""
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else "")
+        if "# lint: ok" in line(node.lineno):
+            continue
+        if attr in ("find", "find_events"):
+            yield (f"{rel}:{node.lineno}: '{attr}(' walks Event "
+                   "objects for the whole store inside the ingest "
+                   "service; stream column blocks instead")
+        elif attr == "scan_columns" and \
+                "# block-budget:" not in line(node.lineno):
+            yield (f"{rel}:{node.lineno}: 'scan_columns(' without a "
+                   "'# block-budget:' marker — the ingest service must "
+                   "slice every scan into bounded blocks before "
+                   "streaming; name the budget on the call line")
+
+
 def check_file(path: Path, root: Path) -> List[str]:
     rel = path.relative_to(root).as_posix()
     text = path.read_text()
@@ -741,6 +782,7 @@ def check_file(path: Path, root: Path) -> List[str]:
     out.extend(_check_streaming_accumulation(tree, text, rel))
     out.extend(_check_hot_route(tree, text, rel))
     out.extend(_check_tenant_growth(tree, text, rel))
+    out.extend(_check_ingest_materialization(tree, text, rel))
     return out
 
 

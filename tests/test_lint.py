@@ -611,3 +611,41 @@ def test_pager_thread_gate_scoped_to_package(tmp_path):
         "    plan.rebalance()\n"
     )
     assert not lint.run(tmp_path)
+
+
+def test_ingest_materialization_gate_catches_whole_store_reads(tmp_path):
+    bad = tmp_path / "predictionio_tpu" / "ingest" / "service.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        '"""doc"""\n'
+        "def run(store):\n"
+        "    evs = list(store.find(1))\n"
+        "    cols = store.scan_columns(1)\n"
+        "    return evs, cols\n"
+    )
+    kinds = "\n".join(lint.run(tmp_path))
+    assert "walks Event objects" in kinds
+    assert "block-budget" in kinds
+
+
+def test_ingest_materialization_gate_allows_budgeted_scan(tmp_path):
+    ok = tmp_path / "predictionio_tpu" / "ingest" / "service.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def run(store):\n"
+        "    return store.scan_columns(1)  # block-budget: BLOCK_ROWS\n"
+    )
+    assert not lint.run(tmp_path)
+
+
+def test_ingest_materialization_gate_scoped_to_service(tmp_path):
+    # the client and pipeline legitimately call scan_columns plain
+    ok = tmp_path / "predictionio_tpu" / "ingest" / "client.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        '"""doc"""\n'
+        "def run(store):\n"
+        "    return store.scan_columns(1)\n"
+    )
+    assert not lint.run(tmp_path)
